@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh for JAX tests.
+
+Multi-chip TPU hardware is unavailable in CI; all sharding/parallelism
+tests run against ``--xla_force_host_platform_device_count=8`` CPU devices,
+the moral equivalent of the reference's CPU-only CI exercising its CUDA
+build (reference .github/workflows/push.yaml:30-48).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,161 @@
+"""Behavioral tests for the fake-tensor layer.
+
+The reference ships a placeholder here (tests/python/test_fake.py:8-9,
+``def test_foo(): assert True``); this suite covers the semantics its docs
+specify (fake.cc handler steps, docs/src/fake_tensor.rst).
+"""
+
+import pytest
+import torch
+import torch.nn as nn
+
+from torchdistx_tpu.fake import (
+    FakeTensor,
+    fake_mode,
+    get_fake_context,
+    has_fake_context,
+    is_fake,
+    meta_tensor,
+    set_fake_context,
+)
+
+
+class TestFactories:
+    def test_factory_is_fake(self):
+        with fake_mode():
+            t = torch.ones(10, 20)
+        assert is_fake(t)
+        assert t.shape == (10, 20)
+        assert t.device == torch.device("cpu")
+
+    def test_factory_with_device_claims_device(self):
+        with fake_mode():
+            t = torch.empty(5, device="tpu")
+            u = torch.zeros(3, device="xla:1")
+        assert t.device.type == "tpu"
+        assert u.device == torch.device("xla:1")
+
+    def test_no_storage_allocated(self):
+        with fake_mode():
+            # 1 TiB tensor: would OOM if real.
+            t = torch.empty(1024, 1024, 1024, 256, device="tpu")
+        assert is_fake(t)
+        assert t.numel() == 1024**3 * 256
+
+    def test_dtype_inference(self):
+        with fake_mode():
+            t = torch.ones(3, dtype=torch.bfloat16)
+            u = torch.arange(10)
+        assert t.dtype == torch.bfloat16
+        assert u.dtype == torch.int64
+
+    def test_meta_device_explicit_stays_meta(self):
+        with fake_mode():
+            t = torch.empty(3, device="meta")
+        assert not is_fake(t)
+        assert t.device.type == "meta"
+
+    def test_tensor_from_data_stays_real(self):
+        # Reference bails out inside torch.Tensor() construction
+        # (deferred_init.cc:776-785); here real-input factories stay real.
+        with fake_mode():
+            t = torch.tensor([1.0, 2.0])
+        assert not is_fake(t)
+        assert torch.equal(t, torch.tensor([1.0, 2.0]))
+
+
+class TestOps:
+    def test_ops_on_fakes_outside_mode(self):
+        with fake_mode():
+            a = torch.ones(4, 8)
+        b = a @ a.t()
+        assert is_fake(b)
+        assert b.shape == (4, 4)
+
+    def test_device_propagation(self):
+        with fake_mode():
+            a = torch.ones(3, device="tpu")
+        b = a + a
+        assert b.device.type == "tpu"
+
+    def test_mixed_fake_devices_error(self):
+        with fake_mode():
+            a = torch.ones(3, device="tpu")
+            b = torch.ones(3, device="xla")
+        with pytest.raises(RuntimeError, match="same device"):
+            a + b
+
+    def test_in_place_preserves_identity(self):
+        with fake_mode():
+            a = torch.ones(3, 3)
+        b = a.mul_(2)
+        assert b is a
+        assert is_fake(a)
+
+    def test_view_shares_meta_storage(self):
+        with fake_mode():
+            a = torch.ones(4, 4)
+        v = a.view(16)
+        assert is_fake(v)
+        assert (
+            meta_tensor(v).untyped_storage()._cdata
+            == meta_tensor(a).untyped_storage()._cdata
+        )
+
+    def test_shape_inference_matmul_broadcast(self):
+        with fake_mode():
+            a = torch.ones(2, 1, 5)
+            b = torch.ones(3, 5)
+        assert (a + b).shape == (2, 3, 5)
+
+    def test_bool_of_fake_raises(self):
+        with fake_mode():
+            a = torch.ones(1)
+        with pytest.raises(RuntimeError):
+            bool(a)
+
+    def test_repr(self):
+        with fake_mode():
+            a = torch.ones(3, device="tpu")
+        assert "fake=True" in repr(a)
+        assert "size=(3,)" in repr(a)
+
+
+class TestModules:
+    def test_linear(self):
+        with fake_mode():
+            m = nn.Linear(10, 20)
+        assert is_fake(m.weight)
+        assert isinstance(m.weight, nn.Parameter)
+        assert m.weight.requires_grad
+
+    def test_large_model_fits(self):
+        # docs/src/fake_tensor.rst:45-67: construct beyond-RAM models.
+        with fake_mode():
+            m = nn.Linear(2**20, 2**18)  # ~1TB of fp32
+        assert is_fake(m.weight)
+
+
+class TestContextRegistry:
+    def test_set_get(self):
+        with fake_mode():
+            t = torch.ones(3)
+        set_fake_context(t, "k", {"x": 1})
+        assert has_fake_context(t, "k")
+        assert get_fake_context(t, "k") == {"x": 1}
+
+    def test_non_fake_raises(self):
+        with pytest.raises(ValueError):
+            set_fake_context(torch.ones(3), "k", 1)
+
+    def test_is_fake_on_real(self):
+        assert not is_fake(torch.ones(3))
+
+
+class TestNesting:
+    def test_reentrant(self):
+        with fake_mode():
+            with fake_mode():
+                t = torch.ones(3)
+            u = torch.ones(3)
+        assert is_fake(t) and is_fake(u)

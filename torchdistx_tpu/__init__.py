@@ -1,0 +1,16 @@
+"""torchdistx_tpu — a TPU-native framework with the capabilities of torchdistX.
+
+Two frontends share one core idea (fake tensors + deferred, replayable
+initialization):
+
+* the **torch frontend** (:mod:`torchdistx_tpu.fake`,
+  :mod:`torchdistx_tpu.deferred_init`) mirrors the reference API surface —
+  ``fake_mode``, ``deferred_init``, ``materialize_tensor``,
+  ``materialize_module`` — via Python dispatch interposition;
+* the **JAX frontend** provides the same capabilities for JAX/flax models
+  via abstract evaluation, and the JAX bridge compiles recorded torch init
+  graphs to XLA programs that materialize parameters directly into sharded
+  TPU HBM (``torchdistx_tpu.abstract`` / ``torchdistx_tpu.jax_bridge``).
+"""
+
+__version__ = "0.1.0.dev0"

@@ -1,0 +1,503 @@
+"""The recorded-operation graph and materialization engine.
+
+TPU-native rebuild of the reference's deferred-init core
+(``/root/reference/src/cc/torchdistx/deferred_init.cc``).  The data model
+mirrors the reference one-to-one:
+
+* :class:`Op` — one recorded ATen call: the op, a preserved (compound-
+  deep-copied) argument stack, and the captured grad-mode state
+  (counterpart of ``Op`` + captured ``ThreadLocalState``,
+  deferred_init.cc:163-297);
+* :class:`OpNode` — a node in the replay DAG: chronological ``op_nr``,
+  output meta-storage keys for alias/in-place detection, dependencies on
+  producing nodes, weak dependent back-edges, and version counters of
+  external (real) tensor arguments (deferred_init.cc:98-161, 309-705);
+* :class:`DeferredInitContext` — the per-fake-tensor context stored in the
+  fake-context registry, updated in place as the fake is re-produced by
+  in-place ops; aliasing outputs are retained via the base's ``views``
+  list so recordings survive the death of view fakes
+  (deferred_init.cc:120-161, 427-458);
+* :func:`materialize` — the replay engine: last-in-place walk, call-stack
+  collection (dependencies + in-place dependents + clobbered readers),
+  chronological replay with external-version verification
+  (deferred_init.cc:502-663, 707-732).
+
+The engine is frontend-agnostic about *where* values land: replay runs
+through a :class:`ReplayTarget`, which the torch frontend instantiates for
+eager CPU replay and :mod:`torchdistx_tpu.jax_bridge` re-implements to
+compile the same graph into an XLA program with sharded outputs.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import torch
+
+from .fake import (
+    FakeTensor,
+    _iter_tensors,
+    get_fake_context,
+    is_fake,
+    set_fake_context,
+    del_fake_context,
+)
+
+CONTEXT_KEY = "deferred_init"
+
+_tls = threading.local()
+
+
+def _next_op_nr() -> int:
+    # Monotone thread-local op number (deferred_init.cc:379, 668): replay
+    # order is chronological recording order.
+    nr = getattr(_tls, "op_nr", 0)
+    _tls.op_nr = nr + 1
+    return nr
+
+
+class _Dep:
+    """Placeholder for a fake argument in a preserved stack.
+
+    The reference nulls out fake tensor args after recording their
+    dependency to break reference cycles (deferred_init.cc:476); we replace
+    them with an index into the node's dependency list.
+    """
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __repr__(self):
+        return f"_Dep({self.index})"
+
+
+def _copy_preserved(obj, fake_to_dep):
+    """copyStack equivalent (deferred_init.cc:65-96): deep-copy compound
+    containers, keep leaves by reference, substitute fakes with deps."""
+    if isinstance(obj, torch.Tensor):
+        if is_fake(obj):
+            return fake_to_dep(obj)
+        return obj
+    if isinstance(obj, (list, tuple)):
+        copied = [_copy_preserved(x, fake_to_dep) for x in obj]
+        return copied if isinstance(obj, list) else tuple(copied)
+    if isinstance(obj, dict):
+        return {k: _copy_preserved(v, fake_to_dep) for k, v in obj.items()}
+    _validate_leaf(obj)
+    return obj
+
+
+_ALLOWED_LEAVES = (
+    type(None), bool, int, float, complex, str,
+    torch.device, torch.dtype, torch.layout, torch.memory_format,
+    torch.Generator, torch.Size,
+)
+
+
+def _validate_leaf(obj) -> None:
+    # validateStack whitelist (deferred_init.cc:230-256): immutable IValue
+    # types only, so replay state is reproducible.
+    if not isinstance(obj, _ALLOWED_LEAVES):
+        raise RuntimeError(
+            f"Argument of type `{type(obj).__name__}` cannot be recorded for "
+            f"deferred initialization; only immutable argument types are "
+            f"supported."
+        )
+
+
+def _storage_key(meta: torch.Tensor) -> int:
+    return meta.untyped_storage()._cdata
+
+
+@dataclass
+class Op:
+    """One recorded call (deferred_init.cc:163-297)."""
+
+    func: Any  # OpOverload or callable with torch-like signature
+    args: tuple
+    kwargs: dict
+    grad_enabled: bool
+    name: str
+
+    def replay(self, target: "ReplayTarget", resolved_args, resolved_kwargs):
+        with torch.set_grad_enabled(self.grad_enabled):
+            return target.run(self, resolved_args, resolved_kwargs)
+
+
+class OpNode:
+    """A node of the replay DAG (deferred_init.cc:309-705)."""
+
+    __slots__ = (
+        "op", "op_nr", "storages", "dependencies", "dependents",
+        "argument_versions", "outputs", "materialized", "__weakref__",
+    )
+
+    def __init__(self, op: Op):
+        self.op = op
+        self.op_nr = _next_op_nr()
+        # Meta storages of fake outputs: the alias/in-place detection key
+        # (deferred_init.cc:384, 413-425).
+        self.storages: Set[int] = set()
+        # (producer node, output index among tensor outputs) per fake input
+        # (OpOutputDescriptor, deferred_init.cc:102-118).
+        self.dependencies: List[Tuple["OpNode", int]] = []
+        # Back-edges; weak so the graph has no cycles (the reference uses
+        # raw pointers erased in the dtor, deferred_init.cc:394, 409-411).
+        self.dependents: "weakref.WeakSet[OpNode]" = weakref.WeakSet()
+        # (tensor, version at record time) for external real tensor args
+        # (deferred_init.cc:391, 477-486).
+        self.argument_versions: List[Tuple[torch.Tensor, int]] = []
+        self.outputs: Optional[List[Any]] = None
+        self.materialized = False
+
+    # -- graph walks -----------------------------------------------------
+
+    def transitive_dependents(self) -> List["OpNode"]:
+        seen: Set[int] = {id(self)}
+        out: List[OpNode] = []
+        stack = list(self.dependents)
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            out.append(n)
+            stack.extend(n.dependents)
+        return out
+
+    def last_in_place_node(self) -> "OpNode":
+        """getLastInPlaceOpNode (deferred_init.cc:537-575): the latest
+        dependent whose outputs alias this node's storages."""
+        last = self
+        for n in self.transitive_dependents():
+            if n.storages & self.storages and n.op_nr > last.op_nr:
+                last = n
+        return last
+
+    def build_call_stack(self) -> List["OpNode"]:
+        """buildCallStack + collectCallStack (deferred_init.cc:526-618).
+
+        Includes: the dependency closure of the last in-place node; every
+        in-place dependent mutating our storages up to that node; and
+        *readers* — non-aliasing dependents of any included node whose
+        input storage is clobbered by a later included in-place op (they
+        must replay before the mutation or they can never replay
+        correctly).
+        """
+        last = self.last_in_place_node()
+        included: Dict[int, OpNode] = {}
+
+        def visit(n: "OpNode") -> None:
+            if id(n) in included:
+                return
+            included[id(n)] = n
+            for dep, _ in n.dependencies:
+                if not dep.materialized:
+                    visit(dep)
+
+        visit(self)
+        if last is not self:
+            visit(last)
+
+        # Fixpoint closure: for every included node, pull in (a) dependents
+        # that alias its storages (in-place mutations and views — the view
+        # chain w → select → add_ must replay even though the final node
+        # does not depend on it), up to the last in-place node; (b) readers
+        # of a storage that a later included in-place op clobbers (they can
+        # never replay correctly afterwards).
+        changed = True
+        while changed:
+            changed = False
+            for n in list(included.values()):
+                for d in list(n.dependents):
+                    if id(d) in included or d.materialized:
+                        continue
+                    if d.op_nr <= last.op_nr and d.storages & n.storages:
+                        visit(d)
+                        changed = True
+                for dep, _ in n.dependencies:
+                    if id(dep) not in included or not (n.storages & dep.storages):
+                        continue  # n is not an in-place mutation of dep's output
+                    for reader in list(dep.dependents):
+                        if (
+                            id(reader) not in included
+                            and reader.op_nr < n.op_nr
+                            and not reader.materialized
+                            and not (reader.storages & dep.storages)
+                        ):
+                            visit(reader)
+                            changed = True
+        stack = sorted(included.values(), key=lambda n: n.op_nr)
+        return stack
+
+    def detach_dependencies(self) -> None:
+        # Free graph memory as materialization proceeds
+        # (deferred_init.cc:518-521).
+        self.dependencies = []
+        self.argument_versions = []
+
+
+class DeferredInitContext:
+    """Per-fake context stored under the deferred-init key
+    (deferred_init.cc:120-161)."""
+
+    __slots__ = ("node", "output_index", "views")
+
+    def __init__(self, node: OpNode, output_index: int):
+        self.node = node
+        self.output_index = output_index
+        # Contexts of aliasing outputs, retained so view recordings survive
+        # the view fake's death (deferred_init.cc:139-160, 427-458).
+        self.views: List["DeferredInitContext"] = []
+
+    def update(self, node: OpNode, output_index: int) -> None:
+        self.node = node
+        self.output_index = output_index
+
+
+# ---------------------------------------------------------------------------
+# Recording (recordOp, deferred_init.cc:670-693, 400-492)
+# ---------------------------------------------------------------------------
+
+
+def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
+    """Record one executed op whose inputs or outputs involve fake tensors."""
+    dependencies: List[Tuple[OpNode, int]] = []
+    seen_fakes: Dict[int, int] = {}
+    # Meta-storage key -> context of the input fake owning that storage,
+    # used for the view keep-alive below.  Populated during the same
+    # traversal that assigns dependency slots so duplicate fake arguments
+    # cannot misalign it.
+    input_storage_ctx: Dict[int, DeferredInitContext] = {}
+
+    def fake_to_dep(fake: FakeTensor) -> _Dep:
+        if id(fake) in seen_fakes:
+            return _Dep(seen_fakes[id(fake)])
+        ctx = get_fake_context(fake, CONTEXT_KEY)
+        if ctx is None:
+            raise RuntimeError(
+                "A tensor that was constructed in a fake-mode context "
+                "outside of deferred-init cannot be used inside a "
+                "deferred-init context (see the reference's identical "
+                "constraint, deferred_init.cc:821-832)."
+            )
+        idx = len(dependencies)
+        seen_fakes[id(fake)] = idx
+        dependencies.append((ctx.node, ctx.output_index))
+        input_storage_ctx.setdefault(_storage_key(fake._meta), ctx)
+        return _Dep(idx)
+
+    preserved_args = _copy_preserved(tuple(args), fake_to_dep)
+    preserved_kwargs = _copy_preserved(dict(kwargs), fake_to_dep)
+
+    op = Op(
+        func=func,
+        args=preserved_args,
+        kwargs=preserved_kwargs,
+        grad_enabled=torch.is_grad_enabled(),
+        name=name or str(func),
+    )
+    node = OpNode(op)
+    node.dependencies = dependencies
+    for dep, _ in dependencies:
+        dep.dependents.add(node)
+
+    # Version counters of external (real) tensor args
+    # (deferred_init.cc:391, 477-486).
+    for t in _iter_tensors((args, kwargs)):
+        if not is_fake(t):
+            # Inference tensors have no version counter; rejected at
+            # materialize time (deferred_init.cc:636-663).
+            version = None if t.is_inference() else t._version
+            node.argument_versions.append((t, version))
+
+    # Outputs: assign contexts; tensor outputs are indexed by position among
+    # tensor outputs (Op::getOutput, deferred_init.cc:270-297).
+    tensor_idx = 0
+    for t in _iter_tensors(out):
+        if is_fake(t):
+            skey = _storage_key(t._meta)
+            node.storages.add(skey)
+            existing = get_fake_context(t, CONTEXT_KEY)
+            if existing is not None:
+                existing.update(node, tensor_idx)
+                ctx = existing
+            else:
+                ctx = DeferredInitContext(node, tensor_idx)
+                set_fake_context(t, CONTEXT_KEY, ctx)
+            # View keep-alive: output aliases an input's storage → retain
+            # the output's context on the base input's context
+            # (deferred_init.cc:427-458).
+            base_ctx = input_storage_ctx.get(skey)
+            if base_ctx is not None and base_ctx is not ctx and ctx not in base_ctx.views:
+                base_ctx.views.append(ctx)
+        tensor_idx += 1
+
+
+# ---------------------------------------------------------------------------
+# Replay (OpNode::materialize + detail::materialize,
+# deferred_init.cc:502-663, 707-732)
+# ---------------------------------------------------------------------------
+
+
+class ReplayTarget:
+    """Where replayed ops execute.
+
+    The base implementation replays eagerly with torch, rewriting claimed
+    accelerator devices (``tpu``/``xla``) to a real torch device.  The JAX
+    bridge subclasses this to *trace* the same graph into a jaxpr instead
+    (see jax_bridge/compile.py).
+    """
+
+    def __init__(self, device: Optional[torch.device] = None):
+        self.device = torch.device(device) if device is not None else torch.device("cpu")
+
+    def rewrite_device(self, d: torch.device) -> torch.device:
+        if d.type in ("tpu", "xla", "meta"):
+            return self.device
+        return d
+
+    def run(self, op: Op, args, kwargs):
+        args = self._rewrite(args)
+        kwargs = self._rewrite(kwargs)
+        return op.func(*args, **kwargs)
+
+    def _rewrite(self, obj):
+        if isinstance(obj, torch.device):
+            return self.rewrite_device(obj)
+        if isinstance(obj, (list, tuple)):
+            r = [self._rewrite(x) for x in obj]
+            return r if isinstance(obj, list) else tuple(r)
+        if isinstance(obj, dict):
+            return {k: self._rewrite(v) for k, v in obj.items()}
+        return obj
+
+
+def _resolve(obj, deps: List[Tuple[OpNode, int]]):
+    if isinstance(obj, _Dep):
+        node, idx = deps[obj.index]
+        return node_output(node, idx)
+    if isinstance(obj, (list, tuple)):
+        r = [_resolve(x, deps) for x in obj]
+        return r if isinstance(obj, list) else tuple(r)
+    if isinstance(obj, dict):
+        return {k: _resolve(v, deps) for k, v in obj.items()}
+    return obj
+
+
+def node_output(node: OpNode, idx: int):
+    assert node.materialized and node.outputs is not None
+    return node.outputs[idx]
+
+
+def _verify_external_args(node: OpNode) -> None:
+    # materializeArguments' external checks (deferred_init.cc:636-663).
+    for t, version in node.argument_versions:
+        if version is None or t.is_inference():
+            raise RuntimeError(
+                f"The tensor argument of `{node.op.name}` is an inference "
+                f"tensor and cannot be used for deferred initialization."
+            )
+        if t._version != version:
+            raise RuntimeError(
+                f"A tensor argument of `{node.op.name}` was modified in "
+                f"place after it was recorded; the recording can no longer "
+                f"be replayed deterministically "
+                f"(see docs/deferred_init.md, and the reference's identical "
+                f"constraint, deferred_init.cc:643-651)."
+            )
+
+
+def replay_node(node: OpNode, target: ReplayTarget) -> None:
+    if node.materialized:
+        return
+    _verify_external_args(node)
+    args = _resolve(node.op.args, node.dependencies)
+    kwargs = _resolve(node.op.kwargs, node.dependencies)
+    out = node.op.replay(target, args, kwargs)
+    outputs: List[Any] = []
+    if isinstance(out, (list, tuple)):
+        for t in out:
+            outputs.append(t)
+    else:
+        outputs.append(out)
+    # Flatten to tensor-position indexing consistent with record time.
+    flat: List[Any] = []
+
+    def _flat(o):
+        if isinstance(o, torch.Tensor):
+            flat.append(o)
+        elif isinstance(o, (list, tuple)):
+            for x in o:
+                _flat(x)
+
+    _flat(out)
+    node.outputs = flat if flat else outputs
+    node.materialized = True
+    node.detach_dependencies()
+
+
+def materialize_graph(node: OpNode, target: ReplayTarget) -> None:
+    """Replay everything `node` needs, in chronological order."""
+    for n in node.build_call_stack():
+        replay_node(n, target)
+
+
+def materialize_many(fakes: Sequence[FakeTensor], target: Optional[ReplayTarget] = None) -> None:
+    """Replay the union of the call stacks of ``fakes`` in global
+    chronological (``op_nr``) order.
+
+    This is how :func:`materialize_module` replays a whole module: random
+    ops then consume the torch RNG in exactly the order the eager
+    constructor would have, giving bitwise parity with eager init under a
+    fixed seed — a property the reference's strictly per-tensor replay
+    cannot provide (its RNG draws happen in materialization order,
+    deferred_init.cc:636-663).
+    """
+    target = target or ReplayTarget()
+    nodes: List[OpNode] = []
+    seen: Set[int] = set()
+    for f in fakes:
+        ctx = get_fake_context(f, CONTEXT_KEY)
+        if ctx is None:
+            continue
+        for n in ctx.node.build_call_stack():
+            if id(n) not in seen:
+                seen.add(id(n))
+                nodes.append(n)
+    for n in sorted(nodes, key=lambda n: n.op_nr):
+        replay_node(n, target)
+
+
+def materialize(
+    fake: FakeTensor,
+    target: Optional[ReplayTarget] = None,
+    *,
+    retain_context: bool = False,
+) -> torch.Tensor:
+    """detail::materialize equivalent (deferred_init.cc:707-732)."""
+    ctx = get_fake_context(fake, CONTEXT_KEY)
+    if ctx is None:
+        if getattr(fake, "_tdx_materialized", False):
+            raise ValueError("The tensor has already been materialized.")
+        raise ValueError(
+            "The tensor was constructed outside of a deferred-init context "
+            "and cannot be materialized."
+        )
+    target = target or ReplayTarget()
+    materialize_graph(ctx.node, target)
+    real = node_output(ctx.node, ctx.output_index)
+    # requires_grad_() is untrackable; re-apply on leaves post-replay
+    # (deferred_init.cc:720-724).
+    if isinstance(real, torch.Tensor) and fake.requires_grad and real.is_leaf:
+        real = real.detach()
+        real.requires_grad_(True)
+    if not retain_context:
+        del_fake_context(fake, CONTEXT_KEY)
+        fake._tdx_materialized = True
+    return real

@@ -1,0 +1,467 @@
+"""Fake tensors for the torch frontend.
+
+TPU-native rebuild of the reference's fake-tensor layer
+(``/root/reference/src/cc/torchdistx/fake.cc``,
+``/root/reference/src/python/torchdistx/fake.py``).
+
+Where the reference hijacks C++ dispatch keys
+(``FuncTorchDynamicLayerBackMode`` as a ``Fake`` key, fake.cc:25-31) and
+registers a boxed catch-all fallback (fake.cc:610-612), this implementation
+uses the modern, supported interposition points: a
+``torch.Tensor._make_wrapper_subclass`` wrapper (``FakeTensor``) plus a
+``TorchDispatchMode`` (``FakeMode``).  The semantics mirror the reference:
+
+* a fake tensor holds a **meta** tensor used for actual dispatch
+  (fake.cc:183) but *claims* a real device (fake.cc:217) — including
+  ``xla:N`` and ``tpu:N`` devices that need no runtime to be present;
+* every op on a fake tensor is redirected to the **meta backend** for
+  shape/dtype inference with no allocation (fake.cc:552-565);
+* factory calls under ``fake_mode()`` produce fakes even with no tensor
+  arguments (``shouldFakeOp``, fake.cc:538-540);
+* in-place ops on the held meta tensor are routed back to the owning fake
+  via a meta→fake back-pointer so the *same* fake is refreshed rather than
+  a new one allocated (Note [Meta to Fake Tensor], fake.cc:68-118,
+  573-596) — here a plain Python attribute on the meta tensor instead of
+  the ``pyobj_`` slot abuse;
+* each fake carries a per-key opaque **context map** (fake.cc:175,
+  655-688) which the deferred-init layer uses to hang its graph node off
+  every fake tensor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Iterator, Optional
+
+import torch
+from torch.utils._python_dispatch import TorchDispatchMode
+
+__all__ = [
+    "FakeTensor",
+    "fake_mode",
+    "is_fake",
+    "meta_tensor",
+    "set_fake_context",
+    "get_fake_context",
+    "has_fake_context",
+    "del_fake_context",
+]
+
+_tls = threading.local()
+
+# Give torch a first-class "tpu" device type so fakes can claim it without
+# any backend present (the reference claims "cuda" devices with no CUDA
+# runtime the same way, docs/src/fake_tensor.rst).  Fakes never dispatch to
+# this device — it exists purely as a claimable identity, so the registered
+# device module is a stub.
+class _TpuDeviceStub:
+    """Identity-only device module: fake tensors claim ``tpu:N`` but all
+    dispatch happens on the meta backend; materialization targets JAX."""
+
+    @staticmethod
+    def is_available() -> bool:
+        return False
+
+    @staticmethod
+    def is_initialized() -> bool:
+        return False
+
+    @staticmethod
+    def device_count() -> int:
+        return 0
+
+    @staticmethod
+    def current_device() -> int:
+        return 0
+
+    @staticmethod
+    def _is_in_bad_fork() -> bool:
+        return False
+
+    @staticmethod
+    def manual_seed_all(seed: int) -> None:
+        pass
+
+    @staticmethod
+    def get_rng_state(device=None):
+        return torch.empty(0, dtype=torch.uint8)
+
+    @staticmethod
+    def set_rng_state(state, device=None) -> None:
+        pass
+
+
+try:  # pragma: no cover - depends on torch build
+    torch.utils.rename_privateuse1_backend("tpu")
+    torch._register_device_module("tpu", _TpuDeviceStub)
+except RuntimeError:
+    pass
+
+
+def _attr_name_of_meta_owner() -> str:
+    return "_tdx_fake_owner"
+
+
+class FakeTensor(torch.Tensor):
+    """A tensor that claims a real device but allocates no storage.
+
+    Counterpart of ``FakeTensorImpl`` (fake.cc:120-347): ``_meta`` is the
+    held meta tensor actually used for dispatch, the wrapper reports the
+    claimed ``device`` and has no accessible storage.
+    """
+
+    _meta: torch.Tensor
+    _fake_device: torch.device
+    _fake_contexts: dict
+
+    @staticmethod
+    def __new__(cls, meta: torch.Tensor, device: torch.device, requires_grad: bool = False):
+        assert meta.device.type == "meta", "FakeTensor must wrap a meta tensor"
+        r = torch.Tensor._make_wrapper_subclass(  # type: ignore[attr-defined]
+            cls,
+            meta.size(),
+            strides=meta.stride(),
+            storage_offset=meta.storage_offset(),
+            dtype=meta.dtype,
+            layout=meta.layout,
+            device=device,
+            requires_grad=requires_grad,
+        )
+        return r
+
+    def __init__(self, meta: torch.Tensor, device: torch.device, requires_grad: bool = False):
+        super().__init__()
+        self._meta = meta
+        self._fake_device = torch.device(device)
+        self._fake_contexts = {}
+        # Meta -> fake back-pointer (fake.cc:330-339 ``setMeta``).  Weakref
+        # so a dead fake does not keep itself alive through its meta.
+        setattr(meta, _attr_name_of_meta_owner(), weakref.ref(self))
+
+    # -- introspection ---------------------------------------------------
+
+    def __repr__(self) -> str:  # fake.py:15-40 repr patch equivalent
+        with no_fake_dispatch():
+            return (
+                f"tensor(..., size={tuple(self.shape)}, dtype={self.dtype}, "
+                f"device='{self._fake_device}', fake=True)"
+            )
+
+    def __bool__(self):
+        raise RuntimeError(
+            "The truth value of a fake tensor cannot be determined: fake "
+            "tensors have no storage. Materialize it first."
+        )
+
+    # -- dispatch --------------------------------------------------------
+
+    @classmethod
+    def __torch_dispatch__(cls, func, types, args=(), kwargs=None):
+        # Ops on fake tensors outside fake_mode() still flow through the
+        # fake handler: in the reference the Fake dispatch key lives in the
+        # tensor's key set, not only in TLS (fake.cc:186-205).
+        return _fake_handler(func, args, kwargs or {})
+
+
+def is_fake(tensor: torch.Tensor) -> bool:
+    """``True`` if ``tensor`` is fake (reference fake.py:53-55, fake.cc:621-627)."""
+    return isinstance(tensor, FakeTensor)
+
+
+def meta_tensor(tensor: torch.Tensor) -> torch.Tensor:
+    """The meta tensor backing a fake (reference ``getFakeMetaStorage``, fake.h:47)."""
+    if not is_fake(tensor):
+        raise ValueError("`tensor` is not fake.")
+    return tensor._meta
+
+
+# ---------------------------------------------------------------------------
+# Per-fake opaque context registry (fake.cc:175, 655-688).
+# ---------------------------------------------------------------------------
+
+
+def set_fake_context(tensor: torch.Tensor, key: str, value: Any) -> None:
+    if not is_fake(tensor):
+        raise ValueError("`tensor` is not fake.")
+    tensor._fake_contexts[key] = value
+
+
+def get_fake_context(tensor: torch.Tensor, key: str) -> Optional[Any]:
+    if not is_fake(tensor):
+        raise ValueError("`tensor` is not fake.")
+    return tensor._fake_contexts.get(key)
+
+
+def has_fake_context(tensor: torch.Tensor, key: str) -> bool:
+    return is_fake(tensor) and key in tensor._fake_contexts
+
+
+def del_fake_context(tensor: torch.Tensor, key: str) -> None:
+    if is_fake(tensor):
+        tensor._fake_contexts.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# The fake handler — counterpart of FakeHandler (fake.cc:349-612).
+# ---------------------------------------------------------------------------
+
+def _skip_level() -> int:
+    return getattr(_tls, "skip_dispatch", 0)
+
+
+@contextlib.contextmanager
+def no_fake_dispatch() -> Iterator[None]:
+    """Run ops on the underlying meta tensors without fake interposition.
+
+    Counterpart of the handler's ``ExcludeDispatchKeyGuard`` self-exclusion
+    (fake.cc:407) — thread-local, like the reference's TLS guard.
+    """
+    _tls.skip_dispatch = _skip_level() + 1
+    try:
+        yield
+    finally:
+        _tls.skip_dispatch = _skip_level() - 1
+
+
+def _tree_map(fn, obj):
+    if isinstance(obj, torch.Tensor):
+        return fn(obj)
+    if isinstance(obj, (list, tuple)):
+        mapped = [_tree_map(fn, x) for x in obj]
+        return type(obj)(mapped) if not isinstance(obj, tuple) else tuple(mapped)
+    if isinstance(obj, dict):
+        return {k: _tree_map(fn, v) for k, v in obj.items()}
+    return obj
+
+
+def _iter_tensors(obj):
+    if isinstance(obj, torch.Tensor):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for x in obj:
+            yield from _iter_tensors(x)
+    elif isinstance(obj, dict):
+        for x in obj.values():
+            yield from _iter_tensors(x)
+
+
+def _infer_fake_device(args, kwargs) -> Optional[torch.device]:
+    """Common claimed device of fake args; errors on mixed fake devices.
+
+    Counterpart of the handler's device inference (fake.cc:402-456): CPU
+    scalar tensors are ignored, mixed devices among fakes are an error.
+    """
+    device: Optional[torch.device] = None
+    for t in _iter_tensors((args, kwargs)):
+        if is_fake(t):
+            d = t._fake_device
+            if device is None:
+                device = d
+            elif device != d:
+                raise RuntimeError(
+                    f"Expected all fake tensors to be on the same device, "
+                    f"but found at least two devices, {device} and {d}!"
+                )
+    return device
+
+
+def _explicit_device(func, args, kwargs) -> Optional[torch.device]:
+    """Locate a ``device=`` argument.
+
+    The reference uses a schema heuristic (BackendSelect kernel or a
+    TensorOptions-shaped parameter run, fake.cc:458-502); with Python
+    schemas available we can simply look the argument up by name.
+    """
+    dev = kwargs.get("device")
+    if dev is not None:
+        return torch.device(dev)
+    try:
+        schema_args = func._schema.arguments
+    except AttributeError:
+        return None
+    for i, a in enumerate(schema_args):
+        if a.name == "device" and i < len(args) and args[i] is not None:
+            return torch.device(args[i])
+    return None
+
+
+def _wrap_output(out, device: torch.device):
+    """Wrap a meta output as fake; refresh existing fakes for in-place ops.
+
+    Counterpart of ``convertMetaOutputsToFakeTensors`` (fake.cc:573-596): if
+    the meta output already belongs to a fake (via the back-pointer), that
+    fake's metadata is refreshed in place and the same fake is returned.
+    """
+    if not isinstance(out, torch.Tensor):
+        return out
+    if is_fake(out):  # already wrapped (e.g. returned arg)
+        return out
+    if out.device.type != "meta":
+        return out
+    owner_ref = getattr(out, _attr_name_of_meta_owner(), None)
+    if owner_ref is not None:
+        owner = owner_ref()
+        if owner is not None:
+            # In-place op mutated the held meta: metadata of the wrapper is
+            # refreshed lazily (shape of wrapper subclass is derived from
+            # construction; for size-changing in-place ops we rebuild).
+            return _refresh_fake(owner, out)
+    return FakeTensor(out, device)
+
+
+def _refresh_fake(owner: FakeTensor, meta: torch.Tensor) -> FakeTensor:
+    """shallowCopyFromMeta equivalent (fake.cc:207-230).
+
+    Wrapper subclass metadata (sizes/strides) cannot be mutated after
+    construction from Python; init-time in-place ops practically never
+    change shape, so refreshing is a no-op unless the shape changed, in
+    which case we rebuild the wrapper and migrate identity-sensitive state.
+    """
+    if owner.shape == meta.shape and owner.stride() == meta.stride():
+        return owner
+    new = FakeTensor(meta, owner._fake_device, owner.requires_grad)
+    new._fake_contexts = owner._fake_contexts
+    return new
+
+
+def _fake_handler(func, args, kwargs, *, force_fake: bool = False):
+    """The catch-all fake handler (FakeHandler::run, fake.cc:406-424).
+
+    Steps mirror the reference: infer device, locate ``device=`` arg, swap
+    fakes for their metas, decide ``shouldFakeOp``, redispatch to the meta
+    backend, wrap meta outputs as fakes.
+    """
+    if _skip_level():
+        with no_fake_dispatch():
+            return func(*args, **kwargs)
+
+    fake_device = _infer_fake_device(args, kwargs)
+    explicit = _explicit_device(func, args, kwargs)
+    has_tensor_args = any(True for _ in _iter_tensors((args, kwargs)))
+
+    # shouldFakeOp (fake.cc:538-540): a fake arg, a device arg, or a pure
+    # factory (no tensor args) makes the op fake.
+    should_fake = force_fake or fake_device is not None or explicit is not None or not has_tensor_args
+    if not should_fake:
+        with no_fake_dispatch():
+            return func(*args, **kwargs)
+
+    # Output device: explicit device arg > first fake arg device > cpu
+    # (fake.cc:504-520).
+    out_device = explicit or fake_device or torch.device("cpu")
+    if out_device.type == "meta":
+        # Asking for meta explicitly: no faking needed, run as-is.
+        with no_fake_dispatch():
+            return func(*_tree_map(lambda t: t._meta if is_fake(t) else t, args),
+                        **_tree_map(lambda t: t._meta if is_fake(t) else t, kwargs))
+
+    # Swap fake args for their meta tensors (fake.cc:522-536).  Real tensor
+    # args are converted to meta *for shape inference only* — the recording
+    # layer keeps the original real tensor in the preserved stack, so its
+    # value is used at replay (the reference redispatches with the real
+    # tensor in place, relying on meta kernels tolerating mixed devices;
+    # converting is the portable equivalent).
+    def _to_meta(t: torch.Tensor) -> torch.Tensor:
+        if is_fake(t):
+            return t._meta
+        if t.device.type == "meta":
+            return t
+        return t.to("meta")
+
+    margs = _tree_map(_to_meta, args)
+    mkwargs = _tree_map(_to_meta, kwargs)
+
+    # Rewrite the device argument to meta (fake.cc:542-550).
+    if explicit is not None:
+        if "device" in mkwargs and mkwargs["device"] is not None:
+            mkwargs = dict(mkwargs)
+            mkwargs["device"] = torch.device("meta")
+        else:
+            try:
+                schema_args = func._schema.arguments
+            except AttributeError:
+                schema_args = []
+            margs = list(margs)
+            for i, a in enumerate(schema_args):
+                if a.name == "device" and i < len(margs) and margs[i] is not None:
+                    margs[i] = torch.device("meta")
+            margs = tuple(margs)
+    elif not has_tensor_args:
+        mkwargs = dict(mkwargs)
+        mkwargs["device"] = torch.device("meta")
+
+    # Redispatch to the meta backend (fake.cc:552-565).  Missing meta
+    # kernels surface as the same actionable error class as the reference.
+    try:
+        with no_fake_dispatch():
+            out = func(*margs, **mkwargs)
+    except NotImplementedError as e:
+        raise NotImplementedError(
+            f"`{func}` has no meta kernel; the fake handler cannot infer "
+            f"its output metadata. See the reference's guidance on meta "
+            f"kernel coverage (docs/src/deferred_init.rst:176-207)."
+        ) from e
+
+    return _tree_map(lambda t: _wrap_output(t, out_device), out)
+
+
+class FakeMode(TorchDispatchMode):
+    """Dispatch-mode counterpart of the TLS-included Fake key (fake.cc:629-645)."""
+
+    def __torch_dispatch__(self, func, types, args=(), kwargs=None):
+        return _fake_handler(func, args, kwargs or {})
+
+
+class ModeToggle:
+    """Re-entrant thread-local enable/disable of a dispatch mode.
+
+    Shared by fake mode (``enableFakeMode``, fake.cc:635-645) and deferred
+    init (``enableDeferredInit``, deferred_init.cc:1140-1160).
+    """
+
+    def __init__(self, mode_cls, name: str):
+        self._mode_cls = mode_cls
+        self._name = name
+        self._tls = threading.local()
+
+    def _stack(self):
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    def set(self, enabled: bool) -> None:
+        stack = self._stack()
+        if enabled:
+            mode = self._mode_cls()
+            stack.append(mode)
+            mode.__enter__()
+        else:
+            if not stack:
+                raise RuntimeError(f"{self._name} is not enabled.")
+            stack.pop().__exit__(None, None, None)
+
+
+_fake_toggle = ModeToggle(FakeMode, "Fake mode")
+
+
+def enable_fake_mode(enabled: bool) -> None:
+    """Re-entrant enable/disable, mirroring ``enableFakeMode`` (fake.cc:635-645)."""
+    _fake_toggle.set(enabled)
+
+
+@contextlib.contextmanager
+def fake_mode() -> Iterator[None]:
+    """Context manager in which all tensors are fake (reference fake.py:43-50).
+
+    Example::
+
+        with fake_mode():
+            t = torch.ones(10, device="tpu")   # no storage allocated
+    """
+    enable_fake_mode(True)
+    try:
+        yield
+    finally:
+        enable_fake_mode(False)
