@@ -11,5 +11,11 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon TPU plugin in this image ignores JAX_PLATFORMS; force the CPU
+# platform through the config API before any jax computation runs.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

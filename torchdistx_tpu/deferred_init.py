@@ -127,7 +127,7 @@ def deferred_init(module_fn: Callable[..., Any], *args: Any, **kwargs: Any):
     operation is recorded into a replay graph, and the result can later be
     materialized tensor-by-tensor (:func:`materialize_tensor`), module-by-
     module (:func:`materialize_module`), or compiled straight into sharded
-    TPU HBM (:func:`torchdistx_tpu.jax_bridge.materialize_module_sharded`).
+    TPU HBM (:func:`torchdistx_tpu.jax_bridge.materialize_module_jax`).
 
     Reference: deferred_init.py:17-36.
     """

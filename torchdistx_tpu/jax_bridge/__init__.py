@@ -1,0 +1,17 @@
+"""JAX bridge: recorded torch init graphs → XLA programs with sharded outputs."""
+
+from .compile import build_init_fn
+from .materialize import (
+    materialize_module_jax,
+    materialize_params_jax,
+    materialize_tensor_jax,
+    named_fake_tensors,
+)
+
+__all__ = [
+    "build_init_fn",
+    "materialize_module_jax",
+    "materialize_params_jax",
+    "materialize_tensor_jax",
+    "named_fake_tensors",
+]

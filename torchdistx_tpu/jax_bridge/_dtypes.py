@@ -1,0 +1,42 @@
+"""torch ↔ jax dtype mapping for the init-graph compiler."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+TORCH_TO_JAX = {
+    torch.float32: jnp.float32,
+    torch.float64: jnp.float64,  # downcast to f32 unless jax_enable_x64
+    torch.float16: jnp.float16,
+    torch.bfloat16: jnp.bfloat16,
+    torch.int8: jnp.int8,
+    torch.int16: jnp.int16,
+    torch.int32: jnp.int32,
+    torch.int64: jnp.int64,
+    torch.uint8: jnp.uint8,
+    torch.bool: jnp.bool_,
+    torch.complex64: jnp.complex64,
+}
+
+JAX_TO_TORCH = {v: k for k, v in TORCH_TO_JAX.items()}
+
+
+def jax_dtype(torch_dtype: torch.dtype):
+    try:
+        return TORCH_TO_JAX[torch_dtype]
+    except KeyError:
+        raise NotImplementedError(
+            f"torch dtype {torch_dtype} has no JAX equivalent in the bridge."
+        ) from None
+
+
+def to_numpy(t: torch.Tensor) -> np.ndarray:
+    """Convert an external (real) torch tensor to numpy for use as a
+    compile-time constant."""
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        # numpy has no bf16; round-trip through f32 (values preserved).
+        return t.to(torch.float32).numpy()
+    return t.numpy()

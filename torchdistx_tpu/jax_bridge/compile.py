@@ -1,0 +1,207 @@
+"""Compile a recorded init graph into a JAX function.
+
+This is the TPU-native replacement for the reference's eager boxed replay
+(``Op::materialize`` → ``OperatorHandle::callBoxed`` on the real backend,
+deferred_init.cc:258-268): instead of replaying op-by-op into host/device
+memory, the whole recording is *traced* into a single JAX function, jitted
+with ``out_shardings``, and executed by XLA — which partitions the init
+computation (including RNG) across the device mesh so each chip computes
+and stores only its own shard.  No full parameter ever exists on the host.
+
+Alias semantics (the hard part of the reference's engine, §3.5 of
+SURVEY.md) are preserved functionally: every value is a ``Box``; views are
+``Box``es with forward/backward lenses onto a base box, so an in-place op
+through a view scatters back into the base — e.g. ``Embedding``'s
+``weight[padding_idx].fill_(0)`` compiles to ``base.at[idx].set(0)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from .._graph import CONTEXT_KEY, OpNode, get_fake_context
+from ..fake import FakeTensor
+from ._dtypes import to_numpy
+from .ops import TABLE
+
+_STRIP_KWARGS = {"device", "layout", "pin_memory", "memory_format", "generator"}
+
+
+class Box:
+    """A mutable binding for one tensor value during graph interpretation."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+    def read(self):
+        return self.array
+
+    def write(self, value) -> None:
+        self.array = value
+
+
+class ViewBox(Box):
+    """A view onto another box: reads through ``fwd``, writes through
+    ``bwd`` (scatter into the base)."""
+
+    __slots__ = ("base", "fwd", "bwd")
+
+    def __init__(self, base: Box, fwd: Callable, bwd: Callable):
+        self.base = base
+        self.fwd = fwd
+        self.bwd = bwd
+
+    def read(self):
+        return self.fwd(self.base.read())
+
+    def write(self, value) -> None:
+        self.base.write(self.bwd(self.base.read(), value))
+
+
+class TraceContext:
+    """Passed to every op impl; provides the per-node RNG key."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.current_op_nr = 0
+
+    def key(self):
+        return jax.random.fold_in(self.base_key, self.current_op_nr)
+
+
+def _op_name(node: OpNode) -> str:
+    func = node.op.func
+    try:
+        return f"{func.namespace}.{func._schema.name.split('::')[-1]}.{func._overloadname or 'default'}"
+    except AttributeError:
+        return node.op.name
+
+
+def _resolve_value(obj, env, deps):
+    """Resolve a preserved-stack entry to a python/jnp value (reads through
+    boxes)."""
+    from .._graph import _Dep
+
+    if isinstance(obj, _Dep):
+        node, idx = deps[obj.index]
+        return env[(id(node), idx)].read()
+    if isinstance(obj, torch.Tensor):
+        return jnp.asarray(to_numpy(obj))
+    if isinstance(obj, (list, tuple)):
+        r = [_resolve_value(x, env, deps) for x in obj]
+        return r if isinstance(obj, list) else tuple(r)
+    if isinstance(obj, dict):
+        return {k: _resolve_value(v, env, deps) for k, v in obj.items()}
+    return obj
+
+
+def _first_dep_box(args, env, deps):
+    from .._graph import _Dep
+
+    for a in args:
+        if isinstance(a, _Dep):
+            node, idx = deps[a.index]
+            return env[(id(node), idx)]
+    raise NotImplementedError("in-place/view op with no tensor input")
+
+
+def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
+    """Evaluate one node into ``env``, keyed by ``(id(node), tensor_idx)``."""
+    if node.materialized and node.outputs is not None:
+        # Terminal ops (aten::item) force early torch materialization during
+        # recording (deferred_init.cc:792-797); their results enter the JAX
+        # program as constants.
+        for i, out in enumerate(node.outputs):
+            if isinstance(out, torch.Tensor):
+                env[(id(node), i)] = Box(jnp.asarray(to_numpy(out)))
+        return
+
+    name = _op_name(node)
+    entry = TABLE.get(name)
+    if entry is None:
+        raise NotImplementedError(
+            f"`{name}` (recorded at op #{node.op_nr}) has no JAX lowering in "
+            f"torchdistx_tpu.jax_bridge.ops. Either add one to the table or "
+            f"materialize this tensor with the eager torch ReplayTarget "
+            f"(torchdistx_tpu.deferred_init.materialize_module) instead."
+        )
+    kind, impl = entry
+
+    ctx.current_op_nr = node.op_nr
+    args = node.op.args
+    kwargs = {k: v for k, v in node.op.kwargs.items() if k not in _STRIP_KWARGS and v is not None}
+    # Positional device/generator-like leaves are stripped by type.
+    args = tuple(a for a in args if not isinstance(a, (torch.device, torch.Generator)))
+
+    if kind == "pure":
+        vals = [_resolve_value(a, env, node.dependencies) for a in args]
+        kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
+        out = impl(ctx, *vals, **kw)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        for i, o in enumerate(outs):
+            env[(id(node), i)] = Box(o)
+    elif kind == "inplace":
+        box = _first_dep_box(args, env, node.dependencies)
+        rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
+        kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
+        new = impl(ctx, box.read(), *rest, **kw)
+        box.write(new)
+        env[(id(node), 0)] = box
+    elif kind == "view":
+        box = _first_dep_box(args, env, node.dependencies)
+        rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
+        kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
+        base_shape = tuple(box.read().shape)
+        fwd, bwd = impl(ctx, base_shape, *rest, **kw)
+        env[(id(node), 0)] = ViewBox(box, fwd, bwd)
+    else:  # pragma: no cover
+        raise AssertionError(kind)
+
+
+def collect_nodes(fakes: Sequence[FakeTensor]) -> List[OpNode]:
+    """Union of the fakes' call stacks in chronological order."""
+    nodes: List[OpNode] = []
+    seen: set = set()
+    for f in fakes:
+        ctx = get_fake_context(f, CONTEXT_KEY)
+        if ctx is None:
+            raise ValueError(
+                "A tensor passed to the JAX materializer has no deferred-init "
+                "recording (it is either real or already materialized)."
+            )
+        for n in ctx.node.build_call_stack():
+            if id(n) not in seen:
+                seen.add(id(n))
+                nodes.append(n)
+    nodes.sort(key=lambda n: n.op_nr)
+    return nodes
+
+
+def build_init_fn(
+    fakes: Sequence[FakeTensor], *, seed: int = 0
+) -> Callable[[], Tuple[jax.Array, ...]]:
+    """Build a zero-arg JAX function computing the values of ``fakes``.
+
+    The function is pure and jittable; pass it to ``jax.jit`` with
+    ``out_shardings`` to materialize directly into sharded device memory.
+    """
+    nodes = collect_nodes(fakes)
+    slots = []
+    for f in fakes:
+        c = get_fake_context(f, CONTEXT_KEY)
+        slots.append((c.node, c.output_index))
+
+    def init_fn():
+        env: Dict = {}
+        tctx = TraceContext(jax.random.PRNGKey(seed))
+        for n in nodes:
+            interpret_node(n, env, tctx)
+        return tuple(env[(id(node), idx)].read() for node, idx in slots)
+
+    return init_fn

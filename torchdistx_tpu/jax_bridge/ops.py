@@ -1,0 +1,477 @@
+"""ATen → JAX op table for the init-graph compiler.
+
+Covers the operator vocabulary that module initializers actually emit at
+the dispatcher level: factories, RNG fills, elementwise in-place math, and
+view ops (``torch.nn.init`` decomposes entirely into this set — e.g.
+``kaiming_uniform_`` records as ``aten.uniform_``, ``trunc_normal_`` as a
+``uniform_``/``erfinv_``/``mul_``/``add_``/``clamp_`` chain).
+
+Each entry declares its kind:
+
+* ``pure``    — ``fn(ctx, *args, **kw) -> array`` (new value);
+* ``inplace`` — ``fn(ctx, current, *args, **kw) -> array`` (write-through,
+  alias-aware via the interpreter's Box/View machinery);
+* ``view``    — ``fn(ctx, base_shape, *args, **kw) -> (fwd, bwd)`` where
+  ``fwd(base)`` reads the view and ``bwd(base, value)`` scatters a new
+  view value back into the base.
+
+RNG policy: every random op draws from ``ctx.key_for(node)`` — a key
+folded from the caller's base seed and the node's chronological ``op_nr``,
+so results are deterministic and independent of materialization order and
+of sharding (unlike torch's sequential generator, this is stable under
+SPMD partitioning).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dtypes import jax_dtype
+
+TABLE: Dict[str, Tuple[str, Callable]] = {}
+
+
+def _reg(names, kind):
+    def deco(fn):
+        for n in names if isinstance(names, (list, tuple)) else [names]:
+            TABLE[n] = (kind, fn)
+        return fn
+
+    return deco
+
+
+def _dtype_of(kw, default=jnp.float32):
+    d = kw.get("dtype")
+    if d is None:
+        return default
+    return jax_dtype(d)
+
+
+# ---------------------------------------------------------------------------
+# Factories
+# ---------------------------------------------------------------------------
+
+
+@_reg(["aten.empty.memory_format", "aten.zeros.default"], "pure")
+def _empty(ctx, size, **kw):
+    # Uninitialized storage is indistinguishable from zeros for a correct
+    # init graph (anything read before being written would be UB in torch).
+    return jnp.zeros(tuple(size), dtype=_dtype_of(kw))
+
+
+@_reg("aten.empty_like.default", "pure")
+def _empty_like(ctx, x, **kw):
+    return jnp.zeros(x.shape, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg("aten.zeros_like.default", "pure")
+def _zeros_like(ctx, x, **kw):
+    return jnp.zeros(x.shape, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg("aten.ones.default", "pure")
+def _ones(ctx, size, **kw):
+    return jnp.ones(tuple(size), dtype=_dtype_of(kw))
+
+
+@_reg("aten.ones_like.default", "pure")
+def _ones_like(ctx, x, **kw):
+    return jnp.ones(x.shape, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg("aten.full.default", "pure")
+def _full(ctx, size, value, **kw):
+    dt = kw.get("dtype")
+    if dt is None:
+        default = jnp.float32 if isinstance(value, float) else jnp.int64
+        return jnp.full(tuple(size), value, dtype=default)
+    return jnp.full(tuple(size), value, dtype=jax_dtype(dt))
+
+
+@_reg("aten.full_like.default", "pure")
+def _full_like(ctx, x, value, **kw):
+    return jnp.full(x.shape, value, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg(["aten.arange.default", "aten.arange.start", "aten.arange.start_step"], "pure")
+def _arange(ctx, *a, **kw):
+    nums = [x for x in a if isinstance(x, (int, float))]
+    start, end, step = 0, None, 1
+    if len(nums) == 1:
+        end = nums[0]
+    elif len(nums) == 2:
+        start, end = nums
+    else:
+        start, end, step = nums[:3]
+    dt = kw.get("dtype")
+    if dt is not None:
+        return jnp.arange(start, end, step, dtype=jax_dtype(dt))
+    if any(isinstance(x, float) for x in (start, end, step)):
+        return jnp.arange(start, end, step, dtype=jnp.float32)
+    return jnp.arange(start, end, step, dtype=jnp.int64)
+
+
+@_reg("aten.eye.default", "pure")
+def _eye(ctx, n, m=None, **kw):
+    return jnp.eye(n, m if isinstance(m, int) else None, dtype=_dtype_of(kw))
+
+
+@_reg("aten.scalar_tensor.default", "pure")
+def _scalar_tensor(ctx, v, **kw):
+    default = jnp.float32 if isinstance(v, float) else jnp.int64
+    return jnp.asarray(v, dtype=_dtype_of(kw, default))
+
+
+@_reg("aten.lift_fresh_copy.default", "pure")
+def _lift_fresh(ctx, x, **kw):
+    return jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# RNG fills
+# ---------------------------------------------------------------------------
+
+
+@_reg("aten.uniform_.default", "inplace")
+def _uniform_(ctx, cur, low=0.0, high=1.0, **kw):
+    compute = cur.dtype if cur.dtype in (jnp.float32, jnp.float64) else jnp.float32
+    u = jax.random.uniform(ctx.key(), cur.shape, dtype=compute, minval=low, maxval=high)
+    return u.astype(cur.dtype)
+
+
+@_reg("aten.normal_.default", "inplace")
+def _normal_(ctx, cur, mean=0.0, std=1.0, **kw):
+    compute = cur.dtype if cur.dtype in (jnp.float32, jnp.float64) else jnp.float32
+    n = jax.random.normal(ctx.key(), cur.shape, dtype=compute) * std + mean
+    return n.astype(cur.dtype)
+
+
+@_reg("aten.normal.Tensor_Tensor", "pure")
+def _normal_tt(ctx, mean, std, **kw):
+    return jax.random.normal(ctx.key(), jnp.broadcast_shapes(mean.shape, std.shape)) * std + mean
+
+
+@_reg("aten.bernoulli_.float", "inplace")
+def _bernoulli_(ctx, cur, p=0.5, **kw):
+    return jax.random.bernoulli(ctx.key(), p, cur.shape).astype(cur.dtype)
+
+
+@_reg(["aten.random_.from", "aten.random_.to", "aten.random_.default"], "inplace")
+def _randint_(ctx, cur, low=0, high=None, **kw):
+    if high is None:
+        low, high = 0, (low if low else 2**31 - 1)
+    return jax.random.randint(ctx.key(), cur.shape, low, high).astype(cur.dtype)
+
+
+@_reg(["aten.rand.default"], "pure")
+def _rand(ctx, size, **kw):
+    return jax.random.uniform(ctx.key(), tuple(size), dtype=_dtype_of(kw))
+
+
+@_reg(["aten.randn.default"], "pure")
+def _randn(ctx, size, **kw):
+    return jax.random.normal(ctx.key(), tuple(size), dtype=_dtype_of(kw))
+
+
+@_reg(["aten.randperm.default"], "pure")
+def _randperm(ctx, n, **kw):
+    return jax.random.permutation(ctx.key(), n).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# In-place fills / elementwise
+# ---------------------------------------------------------------------------
+
+
+@_reg("aten.fill_.Scalar", "inplace")
+def _fill_(ctx, cur, value, **kw):
+    return jnp.full(cur.shape, value, dtype=cur.dtype)
+
+
+@_reg("aten.fill_.Tensor", "inplace")
+def _fill_t(ctx, cur, value, **kw):
+    return jnp.broadcast_to(jnp.asarray(value, dtype=cur.dtype), cur.shape)
+
+
+@_reg("aten.zero_.default", "inplace")
+def _zero_(ctx, cur, **kw):
+    return jnp.zeros_like(cur)
+
+
+@_reg("aten.copy_.default", "inplace")
+def _copy_(ctx, cur, src, non_blocking=False, **kw):
+    return jnp.broadcast_to(jnp.asarray(src), cur.shape).astype(cur.dtype)
+
+
+def _binop_inplace(fn):
+    def impl(ctx, cur, other, *rest, **kw):
+        alpha = kw.get("alpha", rest[0] if rest else 1)
+        return fn(cur, jnp.asarray(other), alpha).astype(cur.dtype)
+
+    return impl
+
+
+TABLE["aten.add_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a + al * b))
+TABLE["aten.add_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a + al * b))
+TABLE["aten.sub_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a - al * b))
+TABLE["aten.sub_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a - al * b))
+TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
+TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
+TABLE["aten.div_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a / b))
+TABLE["aten.div_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a / b))
+
+
+@_reg("aten.erfinv_.default", "inplace")
+def _erfinv_(ctx, cur, **kw):
+    return jax.scipy.special.erfinv(cur).astype(cur.dtype)
+
+
+@_reg("aten.clamp_.default", "inplace")
+def _clamp_(ctx, cur, min=None, max=None, **kw):
+    return jnp.clip(cur, min, max)
+
+
+@_reg("aten.masked_fill_.Scalar", "inplace")
+def _masked_fill_(ctx, cur, mask, value, **kw):
+    return jnp.where(jnp.asarray(mask, dtype=bool), jnp.asarray(value, cur.dtype), cur)
+
+
+@_reg("aten.neg_.default", "inplace")
+def _neg_(ctx, cur, **kw):
+    return -cur
+
+
+@_reg("aten.sqrt_.default", "inplace")
+def _sqrt_(ctx, cur, **kw):
+    return jnp.sqrt(cur)
+
+
+# ---------------------------------------------------------------------------
+# Pure elementwise / reductions / linalg used by exotic inits
+# ---------------------------------------------------------------------------
+
+
+def _pure(fn):
+    def impl(ctx, *args, **kw):
+        return fn(*args, **kw)
+
+    return impl
+
+
+def _binop_pure(fn):
+    def impl(ctx, a, b, *rest, **kw):
+        alpha = kw.get("alpha", rest[0] if rest else 1)
+        return fn(jnp.asarray(a), jnp.asarray(b), alpha)
+
+    return impl
+
+
+TABLE["aten.add.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a + al * b))
+TABLE["aten.add.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a + al * b))
+TABLE["aten.sub.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
+TABLE["aten.sub.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
+TABLE["aten.mul.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a * b))
+TABLE["aten.mul.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a * b))
+TABLE["aten.div.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a / b))
+TABLE["aten.div.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a / b))
+TABLE["aten.pow.Tensor_Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
+
+for name, fn in {
+    "aten.neg.default": lambda x: -x,
+    "aten.sqrt.default": jnp.sqrt,
+    "aten.rsqrt.default": lambda x: 1.0 / jnp.sqrt(x),
+    "aten.abs.default": jnp.abs,
+    "aten.exp.default": jnp.exp,
+    "aten.log.default": jnp.log,
+    "aten.erf.default": jax.scipy.special.erf,
+    "aten.erfinv.default": jax.scipy.special.erfinv,
+    "aten.tanh.default": jnp.tanh,
+    "aten.sign.default": jnp.sign,
+    "aten.clone.default": lambda x, **kw: jnp.asarray(x),
+    "aten.detach.default": lambda x: x,
+    "aten.alias.default": lambda x: x,
+    "aten.contiguous.default": lambda x, **kw: x,
+    "aten.tril.default": lambda x, diagonal=0: jnp.tril(x, diagonal),
+    "aten.triu.default": lambda x, diagonal=0: jnp.triu(x, diagonal),
+    "aten.clamp.default": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    "aten.sum.default": lambda x, **kw: jnp.sum(x),
+    "aten.mean.default": lambda x, **kw: jnp.mean(x),
+    "aten.outer.default": jnp.outer,
+    "aten.sin.default": jnp.sin,
+    "aten.cos.default": jnp.cos,
+    "aten.reciprocal.default": lambda x: 1.0 / x,
+    "aten.floor.default": jnp.floor,
+    "aten.ceil.default": jnp.ceil,
+    "aten.minimum.default": jnp.minimum,
+    "aten.maximum.default": jnp.maximum,
+    "aten.ne.Scalar": lambda a, b: a != b,
+    "aten.eq.Scalar": lambda a, b: a == b,
+    "aten.gt.Scalar": lambda a, b: a > b,
+    "aten.lt.Scalar": lambda a, b: a < b,
+    "aten.logical_not.default": jnp.logical_not,
+    "aten.where.self": jnp.where,
+    "aten.repeat.default": lambda x, reps: jnp.tile(x, tuple(reps)),
+    "aten.mm.default": jnp.matmul,
+    "aten.matmul.default": jnp.matmul,
+    "aten.bmm.default": jnp.matmul,
+    "aten.cumsum.default": lambda x, d, **kw: jnp.cumsum(x, d),
+    "aten.flip.default": lambda x, dims: jnp.flip(x, tuple(dims)),
+}.items():
+    TABLE[name] = ("pure", _pure(fn))
+
+
+@_reg("aten.cat.default", "pure")
+def _cat(ctx, tensors, dim=0, **kw):
+    return jnp.concatenate([jnp.asarray(t) for t in tensors], axis=dim)
+
+
+@_reg("aten.stack.default", "pure")
+def _stack(ctx, tensors, dim=0, **kw):
+    return jnp.stack([jnp.asarray(t) for t in tensors], axis=dim)
+
+
+@_reg("aten._to_copy.default", "pure")
+def _to_copy(ctx, x, **kw):
+    dt = kw.get("dtype")
+    x = jnp.asarray(x)
+    return x.astype(jax_dtype(dt)) if dt is not None else x
+
+
+@_reg(["aten.linalg_qr.default", "aten.qr.default"], "pure")
+def _qr(ctx, x, *a, **kw):
+    # orthogonal_ init support
+    q, r = jnp.linalg.qr(x)
+    return (q, r)
+
+
+# ---------------------------------------------------------------------------
+# Views
+# ---------------------------------------------------------------------------
+
+
+def _compose_perm_inv(perm):
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return inv
+
+
+@_reg(["aten.view.default", "aten._unsafe_view.default", "aten.reshape.default"], "view")
+def _view(ctx, base_shape, size, **kw):
+    size = tuple(size)
+
+    def fwd(b):
+        return jnp.reshape(b, size)
+
+    def bwd(b, v):
+        return jnp.reshape(v, b.shape)
+
+    return fwd, bwd
+
+
+@_reg("aten.t.default", "view")
+def _t(ctx, base_shape, **kw):
+    if len(base_shape) < 2:
+        return (lambda b: b), (lambda b, v: v)
+    return (lambda b: jnp.swapaxes(b, 0, 1)), (lambda b, v: jnp.swapaxes(v, 0, 1))
+
+
+@_reg("aten.transpose.int", "view")
+def _transpose(ctx, base_shape, d0, d1, **kw):
+    return (lambda b: jnp.swapaxes(b, d0, d1)), (lambda b, v: jnp.swapaxes(v, d0, d1))
+
+
+@_reg("aten.permute.default", "view")
+def _permute(ctx, base_shape, perm, **kw):
+    perm = tuple(perm)
+    inv = tuple(_compose_perm_inv(perm))
+    return (lambda b: jnp.transpose(b, perm)), (lambda b, v: jnp.transpose(v, inv))
+
+
+@_reg("aten.select.int", "view")
+def _select(ctx, base_shape, dim, index, **kw):
+    if index < 0:
+        index += base_shape[dim]
+
+    def fwd(b):
+        return jax.lax.index_in_dim(b, index, dim, keepdims=False)
+
+    def bwd(b, v):
+        idx = tuple([slice(None)] * dim + [index])
+        return b.at[idx].set(v.astype(b.dtype))
+
+    return fwd, bwd
+
+
+@_reg("aten.slice.Tensor", "view")
+def _slice(ctx, base_shape, dim=0, start=None, end=None, step=1, **kw):
+    n = base_shape[dim]
+    start = 0 if start is None else (start + n if start < 0 else start)
+    end = n if end is None else min(end + n if end < 0 else end, n)
+    sl = slice(start, end, step)
+
+    def fwd(b):
+        idx = tuple([slice(None)] * dim + [sl])
+        return b[idx]
+
+    def bwd(b, v):
+        idx = tuple([slice(None)] * dim + [sl])
+        return b.at[idx].set(v.astype(b.dtype))
+
+    return fwd, bwd
+
+
+@_reg("aten.unsqueeze.default", "view")
+def _unsqueeze(ctx, base_shape, dim, **kw):
+    if dim < 0:
+        dim += len(base_shape) + 1
+    return (
+        lambda b: jnp.expand_dims(b, dim),
+        lambda b, v: jnp.reshape(v, b.shape),
+    )
+
+
+@_reg("aten.squeeze.dim", "view")
+def _squeeze(ctx, base_shape, dim, **kw):
+    if dim < 0:
+        dim += len(base_shape)
+    if base_shape[dim] != 1:
+        return (lambda b: b), (lambda b, v: v)
+    return (
+        lambda b: jnp.squeeze(b, dim),
+        lambda b, v: jnp.reshape(v, b.shape),
+    )
+
+
+@_reg("aten.squeeze.default", "view")
+def _squeeze_all(ctx, base_shape, **kw):
+    return (
+        lambda b: jnp.squeeze(b),
+        lambda b, v: jnp.reshape(v, b.shape),
+    )
+
+
+@_reg("aten.expand.default", "view")
+def _expand(ctx, base_shape, size, **kw):
+    # expand may add leading dims; -1 entries align with trailing dims.
+    lead = len(size) - len(base_shape)
+    size = tuple(
+        base_shape[i - lead] if s == -1 else s for i, s in enumerate(size)
+    )
+
+    def fwd(b):
+        return jnp.broadcast_to(b, size)
+
+    def bwd(b, v):
+        raise NotImplementedError(
+            "In-place writes through an expand() view are not supported by "
+            "the JAX materializer (ambiguous scatter)."
+        )
+
+    return fwd, bwd

@@ -1,0 +1,13 @@
+"""Parallelism layer: mesh construction, sharding plans, collectives, and
+parallel attention/pipeline/MoE building blocks."""
+
+from .mesh import make_mesh, single_device_mesh
+from .sharding import CallableShardingPlan, ShardingPlan, fsdp_plan
+
+__all__ = [
+    "make_mesh",
+    "single_device_mesh",
+    "ShardingPlan",
+    "CallableShardingPlan",
+    "fsdp_plan",
+]

@@ -1,0 +1,67 @@
+"""Device-mesh construction helpers.
+
+The reference has no distributed layer (SURVEY.md §2.5); this is the
+TPU-native design: a named ``jax.sharding.Mesh`` over the pod slice, with
+conventional axis names shared by the sharding plans, the parallel layers
+(tensor/sequence/pipeline/expert), and the materializer.
+
+Conventional axes:
+
+* ``dp``   — data parallel (pure replication of params, sharded batch);
+* ``fsdp`` — fully-sharded data parallel (params sharded, batch sharded);
+* ``tp``   — tensor/model parallel (Megatron-style, rides ICI);
+* ``sp``   — sequence/context parallel (ring attention);
+* ``ep``   — expert parallel (MoE);
+* ``pp``   — pipeline parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a named mesh from ``{axis_name: size}``.
+
+    Sizes must multiply to the device count; an axis size of ``-1`` is
+    inferred.  Axis order follows :data:`DEFAULT_AXIS_ORDER` for the axes
+    present (pp outermost → tp innermost, so tensor-parallel collectives
+    ride the fastest ICI links, per the scaling-book recipe).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = [a for a in DEFAULT_AXIS_ORDER if a in axes]
+    names += [a for a in axes if a not in names]
+    sizes = [axes[a] for a in names]
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise ValueError("At most one axis size may be -1.")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if n_infer:
+        if len(devices) % known:
+            raise ValueError(
+                f"Cannot infer axis size: {len(devices)} devices not divisible "
+                f"by {known}."
+            )
+        sizes = [len(devices) // known if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"Mesh axes {dict(zip(names, sizes))} require {total} devices, "
+            f"but {len(devices)} are available."
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh({"dp": 1})

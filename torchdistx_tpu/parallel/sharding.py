@@ -1,0 +1,139 @@
+"""Sharding plans: declarative parameter-name → PartitionSpec rules.
+
+This replaces the decision surface the reference leaves to FSDP-style
+callers (fake tensors expose full metadata pre-allocation so "libraries
+... can decide on the optimal strategy", docs/src/deferred_init.rst:17-33,
+100-126).  Here the decision is a first-class, inspectable object used by
+the JAX materializer (``out_shardings``) and by the training step.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rule = Tuple[str, PartitionSpec]
+
+
+class ShardingPlan:
+    """Ordered first-match rules from parameter-name regex to PartitionSpec.
+
+    Example::
+
+        plan = ShardingPlan([
+            (r".*attn\\.(q|k|v)_proj\\.kernel", P(None, ("fsdp", "tp"))),
+            (r".*embed.*", P("tp", "fsdp")),
+        ], default=P())
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[Rule] = (),
+        *,
+        default: PartitionSpec = PartitionSpec(),
+    ):
+        self.rules: List[Tuple[re.Pattern, PartitionSpec]] = [
+            (re.compile(pat), spec) for pat, spec in rules
+        ]
+        self.default = default
+
+    def spec_for(self, name: str, shape: Sequence[int], mesh: Optional[Mesh] = None) -> PartitionSpec:
+        spec = self.default
+        for pat, s in self.rules:
+            if pat.fullmatch(name):
+                spec = s
+                break
+        if mesh is not None:
+            spec = _validate_spec(name, shape, spec, mesh)
+        return spec
+
+    def sharding_for(self, name: str, shape: Sequence[int], mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(name, shape, mesh))
+
+
+def _axis_size(mesh: Mesh, axis: Union[str, Tuple[str, ...], None]) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axis]
+
+
+def _validate_spec(name, shape, spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop mesh axes that do not divide the corresponding dim (with a
+    warning) so materialization never fails on awkward shapes."""
+    if not spec:
+        return spec
+    new_axes = []
+    changed = False
+    for dim, axis in enumerate(spec):
+        if dim >= len(shape):
+            # Spec longer than tensor rank (e.g. a rank-2 rule matching a
+            # rank-1 bias): drop the excess entries.
+            changed = True
+            break
+        if axis is None:
+            new_axes.append(axis)
+            continue
+        size = _axis_size(mesh, axis)
+        if size > 1 and shape[dim] % size != 0:
+            warnings.warn(
+                f"ShardingPlan: `{name}` dim {dim} (size {shape[dim]}) is not "
+                f"divisible by mesh axis {axis!r} (size {size}); replicating "
+                f"that dim instead."
+            )
+            new_axes.append(None)
+            changed = True
+        else:
+            new_axes.append(axis)
+    return PartitionSpec(*new_axes) if changed else spec
+
+
+# -- stock plans -----------------------------------------------------------
+
+
+def fsdp_plan(axis: str = "fsdp", min_size: int = 2**16) -> "CallableShardingPlan":
+    """Shard the largest dim of every parameter over ``axis`` (ZeRO-3-style
+    fully sharded layout), replicating small tensors."""
+
+    def fn(name: str, shape: Sequence[int], mesh: Mesh) -> PartitionSpec:
+        if not shape:
+            return PartitionSpec()
+        n = 1
+        for s in shape:
+            n *= s
+        if n < min_size:
+            return PartitionSpec()
+        size = mesh.shape.get(axis, 1)
+        # largest divisible dim
+        best = None
+        for dim in sorted(range(len(shape)), key=lambda d: -shape[d]):
+            if shape[dim] % size == 0:
+                best = dim
+                break
+        if best is None:
+            return PartitionSpec()
+        axes = [None] * len(shape)
+        axes[best] = axis
+        return PartitionSpec(*axes)
+
+    return CallableShardingPlan(fn)
+
+
+class CallableShardingPlan(ShardingPlan):
+    """A plan computed by a function ``(name, shape, mesh) -> PartitionSpec``."""
+
+    def __init__(self, fn: Callable[[str, Sequence[int], Mesh], PartitionSpec]):
+        super().__init__()
+        self._fn = fn
+
+    def spec_for(self, name, shape, mesh=None):
+        if mesh is None:
+            return PartitionSpec()
+        return _validate_spec(name, shape, self._fn(name, shape, mesh), mesh)
