@@ -1,0 +1,99 @@
+"""Tests for the JAX-native frontend (abstract.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from torchdistx_tpu.abstract import (
+    DeferredArray,
+    deferred_init,
+    is_fake,
+    materialize,
+    materialize_leaf,
+)
+from torchdistx_tpu.parallel import ShardingPlan, make_mesh
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        return nn.Dense(8)(x)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"fsdp": 4, "tp": 2})
+
+
+class TestDeferredInit:
+    def test_no_allocation_metadata(self):
+        params = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        leaves = jax.tree.leaves(params, is_leaf=is_fake)
+        assert all(is_fake(l) for l in leaves)
+        k = params["params"]["Dense_0"]["kernel"]
+        assert k.shape == (16, 32)
+        assert k.path == "params.Dense_0.kernel"
+
+    def test_huge_model_is_free(self):
+        class Huge(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2**17)(x)  # ~17B params at 2**17 input
+
+        params = deferred_init(
+            Huge().init, jax.random.PRNGKey(0), jnp.ones((1, 2**17))
+        )
+        assert params["params"]["Dense_0"]["kernel"].size == 2**34
+
+    def test_value_use_raises(self):
+        params = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        with pytest.raises(RuntimeError, match="no storage"):
+            np.asarray(params["params"]["Dense_0"]["kernel"])
+
+    def test_parity_with_direct_init(self):
+        m = MLP()
+        params = deferred_init(m.init, jax.random.PRNGKey(7), jnp.ones((1, 16)))
+        real = materialize(params)
+        direct = m.init(jax.random.PRNGKey(7), jnp.ones((1, 16)))
+        assert jax.tree.all(
+            jax.tree.map(lambda a, b: bool(jnp.array_equal(a, b)), real, direct)
+        )
+
+
+class TestMaterialize:
+    def test_sharded(self, mesh):
+        params = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        real = materialize(
+            params,
+            mesh=mesh,
+            plan=ShardingPlan([(r".*Dense_0.kernel", P("fsdp", "tp"))]),
+        )
+        k = real["params"]["Dense_0"]["kernel"]
+        assert k.sharding.spec == P("fsdp", "tp")
+        assert k.addressable_shards[0].data.shape == (4, 16)
+
+    def test_leaf_dce(self):
+        params = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        b = materialize_leaf(params["params"]["Dense_1"]["bias"])
+        assert b.shape == (8,)
+
+    def test_subtree(self, mesh):
+        params = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        sub = materialize(params["params"]["Dense_0"], mesh=mesh)
+        assert set(sub.keys()) == {"kernel", "bias"}
+
+    def test_mixed_recordings_rejected(self):
+        p1 = deferred_init(MLP().init, jax.random.PRNGKey(0), jnp.ones((1, 16)))
+        p2 = deferred_init(MLP().init, jax.random.PRNGKey(1), jnp.ones((1, 16)))
+        with pytest.raises(ValueError, match="same deferred_init"):
+            materialize(
+                {"a": p1["params"]["Dense_0"]["kernel"], "b": p2["params"]["Dense_0"]["kernel"]}
+            )
+
+    def test_non_fake_leaf_rejected(self):
+        with pytest.raises(ValueError, match="non-fake"):
+            materialize({"x": jnp.ones(3)})

@@ -1,0 +1,230 @@
+"""JAX-native fake arrays and deferred initialization.
+
+The torch frontend reproduces the reference's *mechanism* (dispatch
+interposition + replay graph, fake.cc / deferred_init.cc).  For JAX
+programs the same two capabilities are idiomatic one-liners in disguise:
+
+* **fake tensors** — abstract evaluation: ``jax.eval_shape`` runs any init
+  function with zero FLOPs and zero allocation, yielding full metadata
+  (the counterpart of meta-backend shape inference, fake.cc:552-565);
+* **the replay graph** — the init *closure itself*: JAX init functions are
+  pure, so instead of recording ops imperatively we capture the function
+  and its arguments; "materialization" is jitting that closure with
+  ``out_shardings`` so XLA computes each parameter's shard in place.
+
+Partial materialization (the reference's ``materialize_tensor`` /
+``check_fn`` surface, deferred_init.py:39-87) falls out of XLA dead-code
+elimination: materializing one leaf compiles a pruned program that
+computes only that leaf's ancestors.
+
+Works with any pytree-returning init — ``flax.linen.Module.init``,
+haiku ``transform().init``, or hand-written factories.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .parallel.sharding import ShardingPlan
+
+__all__ = [
+    "DeferredArray",
+    "deferred_init",
+    "is_fake",
+    "materialize",
+    "materialize_leaf",
+]
+
+
+class _Thunk:
+    """The captured init closure: the JAX-native replay recording."""
+
+    __slots__ = ("fn", "args", "kwargs", "out_treedef", "n_leaves")
+
+    def __init__(self, fn, args, kwargs, out_treedef, n_leaves):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.out_treedef = out_treedef
+        self.n_leaves = n_leaves
+
+    def leaves_fn(self) -> Callable[[], Tuple[jax.Array, ...]]:
+        def run():
+            out = self.fn(*self.args, **self.kwargs)
+            return tuple(jax.tree.leaves(out))
+
+        return run
+
+
+class DeferredArray:
+    """A fake array: full metadata, no storage, plus its recording.
+
+    Counterpart of ``FakeTensorImpl`` (fake.cc:120-347) for the JAX
+    frontend; ``shape``/``dtype`` come from abstract evaluation, the
+    ``_thunk``/``_leaf_idx`` pair plays the role of the fake-context
+    ``DeferredInitContext`` (deferred_init.cc:120-151).
+    """
+
+    __slots__ = ("shape", "dtype", "_thunk", "_leaf_idx", "path")
+
+    def __init__(self, aval: jax.ShapeDtypeStruct, thunk: _Thunk, leaf_idx: int, path: str):
+        self.shape = tuple(aval.shape)
+        self.dtype = aval.dtype
+        self._thunk = thunk
+        self._leaf_idx = leaf_idx
+        self.path = path
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self) -> str:
+        return (
+            f"DeferredArray(shape={self.shape}, dtype={self.dtype.name}, "
+            f"path='{self.path}', fake=True)"
+        )
+
+    def __array__(self, *a, **kw):
+        raise RuntimeError(
+            "A DeferredArray has no storage; materialize it first "
+            "(torchdistx_tpu.abstract.materialize)."
+        )
+
+    def __jax_array__(self):
+        raise RuntimeError(
+            "A DeferredArray has no storage; materialize it first "
+            "(torchdistx_tpu.abstract.materialize)."
+        )
+
+
+def is_fake(x: Any) -> bool:
+    return isinstance(x, DeferredArray)
+
+
+def deferred_init(init_fn: Callable, *args: Any, **kwargs: Any):
+    """Run ``init_fn`` abstractly; return its pytree with every array leaf
+    replaced by a :class:`DeferredArray`.
+
+    Example (flax)::
+
+        model = LlamaModel(config)
+        params = deferred_init(model.init, jax.random.PRNGKey(0), sample_batch)
+        # params: pytree of DeferredArray — zero bytes allocated
+        real = materialize(params, mesh=mesh, plan=plan)
+    """
+    out = jax.eval_shape(init_fn, *args, **kwargs)
+    leaves, treedef = jax.tree.flatten(out)
+    thunk = _Thunk(init_fn, args, kwargs, treedef, len(leaves))
+
+    paths_leaves = jax.tree_util.tree_flatten_with_path(out)[0]
+
+    fake_leaves = []
+    for i, ((path, leaf), _) in enumerate(zip(paths_leaves, leaves)):
+        name = ".".join(str(_key_str(k)) for k in path)
+        fake_leaves.append(DeferredArray(leaf, thunk, i, name))
+    return jax.tree.unflatten(treedef, fake_leaves)
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def _common_thunk(fakes: Sequence[DeferredArray]) -> _Thunk:
+    thunks = {id(f._thunk): f._thunk for f in fakes}
+    if len(thunks) != 1:
+        raise ValueError(
+            "All DeferredArrays in one materialize() call must come from the "
+            "same deferred_init(); got arrays from "
+            f"{len(thunks)} different recordings."
+        )
+    return next(iter(thunks.values()))
+
+
+def materialize(
+    tree: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    plan: Optional[ShardingPlan] = None,
+    specs: Optional[Any] = None,
+):
+    """Materialize a pytree of :class:`DeferredArray` into real (sharded)
+    ``jax.Array``s.
+
+    ``plan`` maps leaf paths to PartitionSpecs; alternatively ``specs`` may
+    be a matching pytree of PartitionSpec.  One XLA program computes all
+    requested leaves; with a mesh, every leaf lands pre-sharded (no host
+    copy, no post-hoc reshard).
+    """
+    fakes, treedef = jax.tree.flatten(tree, is_leaf=is_fake)
+    for f in fakes:
+        if not is_fake(f):
+            raise ValueError(f"materialize() got a non-fake leaf: {type(f)!r}")
+    thunk = _common_thunk(fakes)
+    wanted = [f._leaf_idx for f in fakes]
+    run_all = thunk.leaves_fn()
+
+    def run_selected():
+        leaves = run_all()
+        return tuple(leaves[i] for i in wanted)
+
+    if mesh is not None:
+        if specs is not None:
+            spec_leaves = jax.tree.leaves(
+                specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+            )
+            if len(spec_leaves) != len(fakes):
+                raise ValueError(
+                    f"specs pytree has {len(spec_leaves)} leaves, expected {len(fakes)}."
+                )
+            out_shardings = tuple(NamedSharding(mesh, s) for s in spec_leaves)
+        else:
+            plan = plan or ShardingPlan()
+            out_shardings = tuple(
+                NamedSharding(mesh, plan.spec_for(f.path, f.shape, mesh)) for f in fakes
+            )
+        fn = jax.jit(run_selected, out_shardings=out_shardings)
+    else:
+        fn = jax.jit(run_selected)
+    values = fn()
+    return jax.tree.unflatten(treedef, list(values))
+
+
+def materialize_leaf(
+    fake: DeferredArray,
+    *,
+    mesh: Optional[Mesh] = None,
+    spec: Optional[PartitionSpec] = None,
+) -> jax.Array:
+    """Materialize a single leaf; XLA dead-code-eliminates everything the
+    leaf does not depend on (the JAX-native ``materialize_tensor``)."""
+    if not is_fake(fake):
+        raise ValueError("`fake` is not a DeferredArray.")
+    run_all = fake._thunk.leaves_fn()
+    idx = fake._leaf_idx
+
+    def run_one():
+        return run_all()[idx]
+
+    if mesh is not None:
+        fn = jax.jit(run_one, out_shardings=NamedSharding(mesh, spec or PartitionSpec()))
+    else:
+        fn = jax.jit(run_one)
+    return fn()

@@ -17,7 +17,6 @@ import jax
 import torch
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..deferred_init import materialize_module as _materialize_module_torch
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
 from .compile import build_init_fn

@@ -24,13 +24,13 @@ SPMD partitioning).
 
 from __future__ import annotations
 
-import math
-from functools import partial
-from typing import Any, Callable, Dict, Tuple
+
+
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
 
 from ._dtypes import jax_dtype
 
@@ -163,9 +163,13 @@ def _bernoulli_(ctx, cur, p=0.5, **kw):
 
 
 @_reg(["aten.random_.from", "aten.random_.to", "aten.random_.default"], "inplace")
-def _randint_(ctx, cur, low=0, high=None, **kw):
+def _randint_(ctx, cur, low=None, high=None, **kw):
+    # aten.random_.from(low, to=None) means [low, dtype_max]; .default
+    # means [0, dtype_max] (approximated by int32 max here).
+    if low is None:
+        low = 0
     if high is None:
-        low, high = 0, (low if low else 2**31 - 1)
+        high = 2**31 - 1
     return jax.random.randint(ctx.key(), cur.shape, low, high).astype(cur.dtype)
 
 
@@ -223,8 +227,26 @@ TABLE["aten.sub_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a - al *
 TABLE["aten.sub_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a - al * b))
 TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
 TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
-TABLE["aten.div_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a / b))
-TABLE["aten.div_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a / b))
+def _div(a, b, rounding_mode=None):
+    r = a / b
+    if rounding_mode == "floor":
+        return jnp.floor(r)
+    if rounding_mode == "trunc":
+        return jnp.trunc(r)
+    if rounding_mode is not None:
+        raise NotImplementedError(f"div rounding_mode={rounding_mode!r}")
+    return r
+
+
+def _div_inplace(ctx, cur, other, *rest, **kw):
+    mode = kw.get("rounding_mode", rest[0] if rest else None)
+    return _div(cur, jnp.asarray(other), mode).astype(cur.dtype)
+
+
+TABLE["aten.div_.Tensor"] = ("inplace", _div_inplace)
+TABLE["aten.div_.Scalar"] = ("inplace", _div_inplace)
+TABLE["aten.div_.Tensor_mode"] = ("inplace", _div_inplace)
+TABLE["aten.div_.Scalar_mode"] = ("inplace", _div_inplace)
 
 
 @_reg("aten.erfinv_.default", "inplace")
@@ -278,8 +300,15 @@ TABLE["aten.sub.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
 TABLE["aten.sub.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
 TABLE["aten.mul.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a * b))
 TABLE["aten.mul.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a * b))
-TABLE["aten.div.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a / b))
-TABLE["aten.div.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a / b))
+def _div_pure(ctx, a, b, *rest, **kw):
+    mode = kw.get("rounding_mode", rest[0] if rest else None)
+    return _div(jnp.asarray(a), jnp.asarray(b), mode)
+
+
+TABLE["aten.div.Tensor"] = ("pure", _div_pure)
+TABLE["aten.div.Scalar"] = ("pure", _div_pure)
+TABLE["aten.div.Tensor_mode"] = ("pure", _div_pure)
+TABLE["aten.div.Scalar_mode"] = ("pure", _div_pure)
 TABLE["aten.pow.Tensor_Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
 
 for name, fn in {

@@ -1,0 +1,47 @@
+"""Model families: GPT-2, Llama, T5, Mixtral — flax.linen, TPU-first."""
+
+from .configs import (
+    GPT2_125M,
+    LLAMA3_8B,
+    LLAMA3_70B,
+    MIXTRAL_8X7B,
+    PRESETS,
+    T5_11B,
+    TINY,
+    TINY_GPT2,
+    TINY_MOE,
+    TINY_T5,
+    EncDecConfig,
+    MoEConfig,
+    TransformerConfig,
+)
+from .gpt2 import GPT2Model, make_gpt2
+from .llama import LlamaModel, make_llama
+from .mixtral import make_mixtral
+from .plans import decoder_lm_plan, t5_plan
+from .t5 import T5Model, make_t5
+
+__all__ = [
+    "TransformerConfig",
+    "EncDecConfig",
+    "MoEConfig",
+    "PRESETS",
+    "GPT2_125M",
+    "LLAMA3_8B",
+    "LLAMA3_70B",
+    "MIXTRAL_8X7B",
+    "T5_11B",
+    "TINY",
+    "TINY_GPT2",
+    "TINY_MOE",
+    "TINY_T5",
+    "GPT2Model",
+    "LlamaModel",
+    "T5Model",
+    "make_gpt2",
+    "make_llama",
+    "make_mixtral",
+    "make_t5",
+    "decoder_lm_plan",
+    "t5_plan",
+]

@@ -1,0 +1,59 @@
+"""GPT-2 family (learned positions, LayerNorm, GELU, tied head).
+
+Same scan-stacked TPU structure as the Llama flagship; only the
+positional scheme and block flavor differ (driven by the config).
+Matches the architecture of the reference demo model
+(/root/reference/README.md GPT-2 usage) — BASELINE config 1.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .configs import TransformerConfig
+from .layers import AttnFn, default_attention, make_norm
+from .llama import _BlockWithCarry
+
+
+class GPT2Model(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        B, S = tokens.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wte",
+        )
+        pos_embed = nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="wpe",
+        )
+        x = embed(tokens) + pos_embed(jnp.arange(S, dtype=jnp.int32))[None]
+
+        ScanBlocks = nn.scan(
+            _BlockWithCarry,
+            variable_axes={"params": 0, "losses": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, None), None)
+
+        x = make_norm(cfg)(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def make_gpt2(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> GPT2Model:
+    return GPT2Model(cfg, attn_fn=attn_fn)

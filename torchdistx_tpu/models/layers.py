@@ -1,0 +1,259 @@
+"""Shared transformer building blocks (flax.linen), TPU-first.
+
+Design notes (why this looks nothing like the reference's torch modules):
+
+* layers are stacked with ``nn.scan`` — one compiled block body regardless
+  of depth, params carried as ``(n_layers, ...)`` arrays that shard
+  cleanly (leading dim maps to the ``pp`` axis for pipelining, or stays
+  replicated for pure FSDP);
+* matmuls run in ``config.dtype`` (bfloat16 on TPU → MXU), while norms,
+  softmax and RoPE rotate in float32 for stability;
+* attention is pluggable: the default is plain XLA dot-product attention
+  (fused well by Mosaic/XLA); ``parallel.ring_attention`` provides the
+  sequence-parallel ring variant with the same signature.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .configs import MoEConfig, TransformerConfig
+
+AttnFn = Callable[..., jax.Array]
+
+
+def default_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, KV, D]
+    v: jax.Array,  # [B, S, KV, D]
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain XLA attention with GQA head-group broadcasting, f32 softmax."""
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    groups = H // KV
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(D))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    qf = qf.reshape(B, S, KV, groups, D)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
+    if bias is not None:
+        # bias: [H or 1, S, T] broadcastable
+        logits = logits + bias.reshape(1, KV, groups, *bias.shape[-2:])
+    if causal:
+        T = k.shape[1]
+        mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, vf)
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        y = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+class LayerNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale + bias).astype(dtype)
+
+
+def make_norm(cfg: TransformerConfig):
+    return RMSNorm(eps=cfg.norm_eps) if cfg.norm == "rmsnorm" else LayerNorm(eps=cfg.norm_eps)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:
+    """[max_len, head_dim//2] complex rotation angles, f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    return jnp.outer(t, inv)  # [L, D/2]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; angles: [S, D/2]."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, x, *, angles=None, bias=None, causal=True):
+        cfg = self.cfg
+        D = cfg.head_size
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=cfg.use_bias, name=name,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        q = dense((cfg.n_heads, D), "wq")(x)
+        k = dense((cfg.kv_heads, D), "wk")(x)
+        v = dense((cfg.kv_heads, D), "wv")(x)
+        if angles is not None:
+            q = apply_rope(q, angles)
+            k = apply_rope(k, angles)
+        out = self.attn_fn(q, k, v, causal=causal, bias=bias)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, name="wo",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )(out)
+
+
+class CrossAttention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, x, kv, *, bias=None):
+        cfg = self.cfg
+        D = cfg.head_size
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, name=name,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        q = dense((cfg.n_heads, D), "wq")(x)
+        k = dense((cfg.kv_heads, D), "wk")(kv)
+        v = dense((cfg.kv_heads, D), "wv")(kv)
+        out = self.attn_fn(q, k, v, causal=False, bias=bias)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False, name="wo",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        if cfg.activation == "silu":  # SwiGLU
+            gate = jax.nn.silu(dense(cfg.d_ff, "w_gate")(x))
+            up = dense(cfg.d_ff, "w_up")(x)
+            return dense(cfg.d_model, "w_down")(gate * up)
+        h = jax.nn.gelu(dense(cfg.d_ff, "w_up")(x), approximate=True)
+        return dense(cfg.d_model, "w_down")(h)
+
+
+class MoEMLP(nn.Module):
+    """Capacity-based token-choice MoE (Switch/GShard dispatch pattern).
+
+    Dispatch/combine are einsums over a one-hot [tokens, experts, capacity]
+    tensor — the canonical GSPMD-partitionable formulation: sharding the
+    expert dim over the ``ep`` mesh axis turns the dispatch einsum into an
+    all-to-all, with no manual collectives.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        moe: MoEConfig = cfg.moe
+        B, S, D = x.shape
+        T = B * S
+        E = moe.n_experts
+        k = moe.top_k
+        capacity = max(1, int(math.ceil(T * k / E * 1.25)))
+
+        xt = x.reshape(T, D)
+        router = nn.Dense(
+            E, use_bias=False, name="router", dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )(xt.astype(jnp.float32))  # [T, E]
+        probs = jax.nn.softmax(router, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+        gate_vals = gate_vals / jnp.clip(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # position of each (token, choice) in its expert's buffer
+        onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [T, k, E]
+        pos_in_expert = (jnp.cumsum(onehot.reshape(T * k, E), axis=0) - 1).reshape(T, k, E)
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [T, k]
+        keep = pos < capacity
+
+        # dispatch/combine tensors [T, E, C]
+        eo = jax.nn.one_hot(gate_idx, E, dtype=x.dtype)  # [T,k,E]
+        po = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity, dtype=x.dtype)  # [T,k,C]
+        disp = jnp.einsum("tke,tkc->tec", eo, po)  # [T,E,C] 0/1
+        comb = jnp.einsum("tke,tkc,tk->tec", eo, po, gate_vals.astype(x.dtype))
+
+        expert_in = jnp.einsum("tec,td->ecd", disp, xt)  # [E,C,D]
+
+        w_gate = self.param(
+            "w_gate", nn.initializers.lecun_normal(), (E, D, cfg.d_ff), cfg.param_dtype
+        )
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(), (E, D, cfg.d_ff), cfg.param_dtype
+        )
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(), (E, cfg.d_ff, D), cfg.param_dtype
+        )
+        h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(x.dtype)))
+        h = h * jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(x.dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(x.dtype))
+
+        out = jnp.einsum("tec,ecd->td", comb, expert_out)
+
+        # load-balancing aux loss (GShard eq.4), stashed for the trainer
+        me = jnp.mean(probs, axis=0)  # [E]
+        ce = jnp.mean(jnp.sum(eo, axis=1), axis=0)  # fraction routed per expert
+        aux = jnp.sum(me * ce) * E * moe.router_aux_weight
+        self.sow("losses", "router_aux", aux)
+
+        return out.reshape(B, S, D)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block; MoE if the config says so."""
+
+    cfg: TransformerConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, x, *, angles=None, bias=None, causal=True):
+        cfg = self.cfg
+        h = make_norm(cfg)(x)
+        x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
+            h, angles=angles, bias=bias, causal=causal
+        )
+        h = make_norm(cfg)(x)
+        if cfg.moe is not None:
+            x = x + MoEMLP(cfg, name="moe")(h)
+        else:
+            x = x + MLP(cfg, name="mlp")(h)
+        return x

@@ -1,0 +1,82 @@
+"""Llama-family decoder-only LM (flax.linen), the flagship model.
+
+TPU-first structure: blocks are stacked with ``nn.scan`` (params carried
+as ``(n_layers, ...)`` arrays — O(1) compile time in depth, clean leading
+dim for pipeline sharding), compute in bf16 on the MXU, f32 norms/softmax,
+optional full rematerialization for the 70B-class configs.
+
+Also serves Mixtral: a config with ``moe`` set swaps the dense MLP for the
+capacity-based MoE block (models/layers.py).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .configs import TransformerConfig
+from .layers import AttnFn, Block, default_attention, make_norm, rope_frequencies
+
+
+class _BlockWithCarry(nn.Module):
+    """Adapter giving Block the carry signature nn.scan expects; applies
+    rematerialization per the config."""
+
+    cfg: TransformerConfig
+    attn_fn: AttnFn
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, angles = carry
+        block_cls = Block
+        if self.cfg.remat == "full":
+            block_cls = nn.remat(Block, prevent_cse=False, static_argnums=())
+        x = block_cls(self.cfg, attn_fn=self.attn_fn, name="block")(x, angles=angles)
+        return (x, angles), None
+
+
+class LlamaModel(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, vocab] in f32."""
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed",
+        )
+        x = embed(tokens)
+        S = tokens.shape[1]
+        angles = rope_frequencies(cfg.head_size, S, cfg.rope_theta)
+
+        ScanBlocks = nn.scan(
+            _BlockWithCarry,
+            variable_axes={"params": 0, "losses": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, angles), None)
+
+        x = make_norm(cfg)(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def make_llama(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> LlamaModel:
+    return LlamaModel(cfg, attn_fn=attn_fn)

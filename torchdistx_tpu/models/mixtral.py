@@ -1,0 +1,20 @@
+"""Mixtral-family MoE decoder (Llama blocks + capacity-based MoE MLP).
+
+BASELINE config 5 (Mixtral 8×7B, per-expert shard materialize).  The
+architecture is the Llama flagship with ``config.moe`` set; expert weights
+are ``(n_layers, n_experts, ...)`` arrays whose expert dim shards over the
+``ep`` mesh axis (see models/plans.py), which is exactly the "per-expert
+shard" materialization target.
+"""
+
+from __future__ import annotations
+
+from .configs import TransformerConfig
+from .layers import AttnFn, default_attention
+from .llama import LlamaModel
+
+
+def make_mixtral(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> LlamaModel:
+    if cfg.moe is None:
+        raise ValueError("Mixtral config must have `moe` set.")
+    return LlamaModel(cfg, attn_fn=attn_fn)

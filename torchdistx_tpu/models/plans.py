@@ -1,0 +1,59 @@
+"""Stock sharding plans for the model families.
+
+Megatron-style 2D (fsdp × tp) layouts over the scan-stacked parameter
+trees, with expert weights over ``ep``.  Paths are the flattened flax
+param paths (e.g. ``params.blocks.block.attn.wq.kernel``); the leading
+layer dim stays unsharded (it belongs to ``pp`` when pipelining, handled
+by parallel/pipeline.py's own layout).
+
+All rules degrade gracefully: indivisible dims fall back to replication
+with a warning (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import ShardingPlan
+
+
+def decoder_lm_plan(*, fsdp: str = "fsdp", tp: str = "tp", ep: str = "ep") -> ShardingPlan:
+    """Plan for LlamaModel / GPT2Model / Mixtral param trees."""
+    return ShardingPlan(
+        [
+            # attention projections [L, d, H, hd] / [L, H, hd, d]
+            (r".*attn\.w[qkv]\.kernel", P(None, fsdp, tp, None)),
+            (r".*attn\.wo\.kernel", P(None, tp, None, fsdp)),
+            (r".*attn\.w[qkv]\.bias", P(None, tp, None)),
+            (r".*attn\.wo\.bias", P()),
+            # dense MLP [L, d, ff] / [L, ff, d]
+            (r".*mlp\.w_(gate|up)\.kernel", P(None, fsdp, tp)),
+            (r".*mlp\.w_down\.kernel", P(None, tp, fsdp)),
+            (r".*mlp\.w_(gate|up)\.bias", P(None, tp)),
+            (r".*mlp\.w_down\.bias", P()),
+            # MoE experts [L, E, d, ff] / [L, E, ff, d]
+            (r".*moe\.w_(gate|up)", P(None, ep, fsdp, tp)),
+            (r".*moe\.w_down", P(None, ep, tp, fsdp)),
+            (r".*moe\.router\.kernel", P(None, fsdp, None)),
+            # embeddings / head
+            (r".*(embed|wte)\.embedding", P(tp, fsdp)),
+            (r".*wpe\.embedding", P(None, fsdp)),
+            (r".*lm_head\.kernel", P(fsdp, tp)),
+            # norms and everything else: replicated (default)
+        ]
+    )
+
+
+def t5_plan(*, fsdp: str = "fsdp", tp: str = "tp") -> ShardingPlan:
+    """2D plan for T5Model param trees (BASELINE "GSPMD 2D shard")."""
+    return ShardingPlan(
+        [
+            (r".*(attn|cross)\.w[qkv]\.kernel", P(None, fsdp, tp, None)),
+            (r".*(attn|cross)\.wo\.kernel", P(None, tp, None, fsdp)),
+            (r".*mlp\.w_(gate|up)\.kernel", P(None, fsdp, tp)),
+            (r".*mlp\.w_down\.kernel", P(None, tp, fsdp)),
+            (r".*shared_embed\.embedding", P(tp, fsdp)),
+            (r".*relpos\.embedding", P(None, tp)),
+            (r".*lm_head\.kernel", P(fsdp, tp)),
+        ]
+    )

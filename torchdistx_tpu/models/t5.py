@@ -1,0 +1,163 @@
+"""T5-family encoder-decoder (relative position bias, shared embedding).
+
+BASELINE config 4 (T5-11B, GSPMD 2D shard).  Same scan-stacked structure
+as the decoder-only models; the relative position bias is computed once
+per stack and shared across layers (as in T5), entering attention as an
+additive logit bias.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .configs import EncDecConfig, TransformerConfig
+from .layers import (
+    AttnFn,
+    Attention,
+    CrossAttention,
+    MLP,
+    default_attention,
+    make_norm,
+)
+
+
+def _relative_buckets(rel_pos, *, bidirectional: bool, num_buckets: int, max_distance: int):
+    """T5 relative-position bucketing (log-spaced beyond max_exact)."""
+    ret = 0
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class RelativePositionBias(nn.Module):
+    cfg: TransformerConfig
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, qlen: int, klen: int) -> jax.Array:
+        cfg = self.cfg
+        ctx = jnp.arange(qlen)[:, None]
+        mem = jnp.arange(klen)[None, :]
+        buckets = _relative_buckets(
+            mem - ctx,
+            bidirectional=self.bidirectional,
+            num_buckets=cfg.relative_pos_buckets,
+            max_distance=cfg.relative_pos_max_distance,
+        )
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (cfg.relative_pos_buckets, cfg.n_heads),
+            jnp.float32,
+        )
+        return jnp.transpose(table[buckets], (2, 0, 1))  # [H, qlen, klen]
+
+
+class _EncBlock(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, bias = carry
+        cfg = self.cfg
+        h = make_norm(cfg)(x)
+        x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
+            h, bias=bias, causal=False
+        )
+        h = make_norm(cfg)(x)
+        x = x + MLP(cfg, name="mlp")(h)
+        return (x, bias), None
+
+
+class _DecBlock(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, enc, bias = carry
+        cfg = self.cfg
+        h = make_norm(cfg)(x)
+        x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
+            h, bias=bias, causal=True
+        )
+        h = make_norm(cfg)(x)
+        x = x + CrossAttention(cfg, attn_fn=self.attn_fn, name="cross")(h, enc)
+        h = make_norm(cfg)(x)
+        x = x + MLP(cfg, name="mlp")(h)
+        return (x, enc, bias), None
+
+
+def _scan(block_cls, cfg, attn_fn, name):
+    return nn.scan(
+        block_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        length=cfg.n_layers,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg, attn_fn, name=name)
+
+
+class T5Model(nn.Module):
+    cfg: EncDecConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, enc_tokens: jax.Array, dec_tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.encoder.d_model,
+            dtype=cfg.encoder.dtype, param_dtype=cfg.encoder.param_dtype,
+            name="shared_embed",
+        )
+
+        # Encoder
+        e = embed(enc_tokens)
+        ebias = RelativePositionBias(cfg.encoder, bidirectional=True, name="enc_relpos")(
+            enc_tokens.shape[1], enc_tokens.shape[1]
+        )
+        (e, _), _ = _scan(_EncBlock, cfg.encoder, self.attn_fn, "enc_blocks")((e, ebias), None)
+        e = make_norm(cfg.encoder)(e)
+
+        # Decoder
+        d = embed(dec_tokens)
+        dbias = RelativePositionBias(cfg.decoder, bidirectional=False, name="dec_relpos")(
+            dec_tokens.shape[1], dec_tokens.shape[1]
+        )
+        (d, _, _), _ = _scan(_DecBlock, cfg.decoder, self.attn_fn, "dec_blocks")(
+            (d, e, dbias), None
+        )
+        d = make_norm(cfg.decoder)(d)
+
+        if cfg.tie_embeddings:
+            # T5 rescales before the tied head
+            d = d * (cfg.decoder.d_model ** -0.5)
+            logits = embed.attend(d.astype(cfg.decoder.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.decoder.dtype,
+                param_dtype=cfg.decoder.param_dtype, name="lm_head",
+            )(d)
+        return logits.astype(jnp.float32)
+
+
+def make_t5(cfg: EncDecConfig, attn_fn: AttnFn = default_attention) -> T5Model:
+    return T5Model(cfg, attn_fn=attn_fn)

@@ -1,0 +1,78 @@
+"""Named-axis collective wrappers.
+
+The reference has no communication backend at all (SURVEY.md §2.5 — no
+NCCL/MPI/c10d anywhere); the TPU-native design uses XLA collectives over
+ICI/DCN, reached through named mesh axes inside ``shard_map``.  These
+wrappers exist so the rest of the framework (ring attention, pipeline,
+MoE) speaks one vocabulary, accepts single-or-multiple axis names, and is
+trivially no-op when an axis has size 1 (so the same code runs on any
+mesh shape).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def axis_size(axis: Axis) -> int:
+    return lax.psum(1, axis)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def psum(x, axis: Axis):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: Axis):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: Axis):
+    return lax.pmax(x, axis)
+
+
+def all_gather(x, axis: str, *, dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, dim: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int):
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
+
+
+def ppermute_next(x, axis: str):
+    """Rotate values one step "forward" along a ring (device i → i+1)."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def ppermute_prev(x, axis: str):
+    """Rotate values one step "backward" along a ring (device i → i-1)."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def send_next(x, axis: str):
+    """Shift to the next stage without wraparound (pipeline edge); stage 0
+    receives zeros."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [(i, i + 1) for i in range(n - 1)])
+
+
+def send_prev(x, axis: str):
+    """Shift to the previous stage without wraparound; last stage receives
+    zeros."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [(i + 1, i) for i in range(n - 1)])

@@ -1,0 +1,163 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+The models stack their layers with ``nn.scan``, so every block parameter
+already carries a leading ``(n_layers, ...)`` dim — pipelining is *just a
+sharding decision* on that dim: shard it over ``pp`` (each stage holds
+``n_layers / pp_size`` layers), run the local layers with ``lax.scan``,
+and rotate activations stage-to-stage with ``ppermute`` through the
+classic fill/steady/drain schedule.  Differentiable end-to-end (ppermute
+transposes to the reverse permute, so GPipe's backward schedule falls out
+of jax.grad).
+
+Entry points:
+
+* :func:`pipeline_forward` — the per-device schedule, inside ``shard_map``;
+* :func:`pipelined_decoder_apply` — full decoder LM forward (embed →
+  pipelined blocks → norm/head) for LlamaModel/GPT2Model param trees;
+* :func:`pipeline_plan_overrides` — plan rules putting the layer dim of
+  block params on ``pp`` so deferred-init materializes each stage's layers
+  straight onto its own devices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..models.configs import TransformerConfig
+from ..models.layers import Block, default_attention, make_norm, rope_frequencies
+from .collectives import send_next
+
+
+def pipeline_forward(
+    stage_fn: Callable,
+    stage_params,
+    x_mb: jax.Array,  # [n_mb, mb, S, d]
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run the GPipe schedule; call inside ``shard_map`` over ``axis_name``.
+
+    ``stage_fn(stage_params, x) -> y`` runs this stage's layers.  Returns
+    the final activations for all microbatches (valid on every stage after
+    the closing psum-broadcast).
+    """
+    n = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_mb = x_mb.shape[0]
+    total = n_mb + n - 1
+
+    buf = jnp.zeros_like(x_mb[0])
+    outs = jnp.zeros_like(x_mb)
+
+    def body(t, carry):
+        buf, outs = carry
+        feed_idx = jnp.clip(t, 0, n_mb - 1)
+        inp = jnp.where(stage == 0, x_mb[feed_idx], buf)
+        y = stage_fn(stage_params, inp)
+        mb_idx = t - (n - 1)
+        valid = (stage == n - 1) & (mb_idx >= 0) & (mb_idx < n_mb)
+        widx = jnp.clip(mb_idx, 0, n_mb - 1)
+        outs = outs.at[widx].set(jnp.where(valid, y, outs[widx]))
+        buf = send_next(y, axis_name)
+        return (buf, outs)
+
+    _, outs = lax.fori_loop(0, total, body, (buf, outs), unroll=False)
+    # Broadcast the last stage's outputs to all stages.
+    return lax.psum(jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+
+
+def _block_chain(cfg: TransformerConfig, attn_fn, angles):
+    block = Block(cfg, attn_fn=attn_fn)
+
+    def chain(stacked_params, x):
+        def body(carry, layer_params):
+            y = block.apply({"params": layer_params}, carry, angles=angles)
+            return y, None
+
+        y, _ = lax.scan(body, x, stacked_params)
+        return y
+
+    return chain
+
+
+def pipelined_decoder_apply(
+    cfg: TransformerConfig,
+    params,
+    tokens: jax.Array,  # [B, S]
+    mesh: Mesh,
+    *,
+    n_microbatches: int = 4,
+    axis_name: str = "pp",
+    attn_fn=default_attention,
+    positions: str = "rope",
+):
+    """Full decoder-LM forward with pipelined blocks.
+
+    Embedding and head run replicated across stages (their params are
+    small relative to the blocks); the blocks' layer dim is sharded over
+    ``pp``.  Works for LlamaModel ('embed') and GPT2Model ('wte'/'wpe')
+    param trees.
+    """
+    p = params["params"]
+    B, S = tokens.shape
+    assert B % n_microbatches == 0, (
+        f"n_microbatches ({n_microbatches}) must divide the batch size ({B})"
+    )
+
+    if "embed" in p:
+        emb_mod = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        )
+        x = emb_mod.apply({"params": p["embed"]}, tokens)
+        embed_table = p["embed"]["embedding"]
+    else:  # gpt2
+        emb_mod = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        )
+        x = emb_mod.apply({"params": p["wte"]}, tokens)
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        ).apply({"params": p["wpe"]}, jnp.arange(S, dtype=jnp.int32))[None]
+        embed_table = p["wte"]["embedding"]
+
+    angles = rope_frequencies(cfg.head_size, S, cfg.rope_theta) if positions == "rope" else None
+    chain = _block_chain(cfg, attn_fn, angles)
+
+    x_mb = x.reshape(n_microbatches, B // n_microbatches, S, cfg.d_model)
+
+    pp_fn = shard_map(
+        partial(pipeline_forward, chain, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    y = pp_fn(p["blocks"]["block"], x_mb)
+    x = y.reshape(B, S, cfg.d_model)
+
+    # final norm + head (replicated compute)
+    norm_key = next(k for k in p.keys() if "Norm" in k)
+    x = make_norm(cfg).apply({"params": p[norm_key]}, x)
+    if cfg.tie_embeddings or "lm_head" not in p:
+        logits = x.astype(cfg.param_dtype) @ embed_table.T
+    else:
+        logits = x @ p["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def pipeline_plan_overrides(axis_name: str = "pp"):
+    """Plan rules sharding the layer dim of block params over ``pp`` —
+    prepend to a model plan so materialization lands each stage's layers
+    on its own devices."""
+    return [
+        (r".*blocks\.block\..*", P(axis_name)),
+    ]

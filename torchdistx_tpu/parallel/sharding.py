@@ -60,9 +60,39 @@ def _axis_size(mesh: Mesh, axis: Union[str, Tuple[str, ...], None]) -> int:
     if isinstance(axis, (tuple, list)):
         n = 1
         for a in axis:
-            n *= mesh.shape[a]
+            n *= mesh.shape.get(a, 1)
         return n
-    return mesh.shape[axis]
+    return mesh.shape.get(axis, 1)
+
+
+def _drop_absent_axes(axis, mesh: Mesh):
+    """Remove mesh axes the spec names but the mesh lacks (a plan written
+    for a dp×fsdp×tp×ep mesh degrades gracefully on smaller meshes).
+    Warns once per axis name so typo'd axes are not silently replicated."""
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        kept = tuple(a for a in axis if not _absent(a, mesh))
+        if not kept:
+            return None
+        return kept if len(kept) > 1 else kept[0]
+    return None if _absent(axis, mesh) else axis
+
+
+_warned_axes = set()
+
+
+def _absent(a: str, mesh: Mesh) -> bool:
+    if a in mesh.shape:
+        return False
+    if a not in _warned_axes:
+        _warned_axes.add(a)
+        warnings.warn(
+            f"ShardingPlan: mesh has no axis {a!r} "
+            f"(axes: {tuple(mesh.shape)}); dims naming it will be replicated. "
+            f"Check for typos if this is unexpected."
+        )
+    return True
 
 
 def _validate_spec(name, shape, spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
@@ -78,6 +108,10 @@ def _validate_spec(name, shape, spec: PartitionSpec, mesh: Mesh) -> PartitionSpe
             # rank-1 bias): drop the excess entries.
             changed = True
             break
+        dropped = _drop_absent_axes(axis, mesh)
+        if dropped != axis:
+            changed = True
+            axis = dropped
         if axis is None:
             new_axes.append(axis)
             continue
