@@ -1,0 +1,30 @@
+# Build targets. `make native` builds the C++ graph engine into
+# torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
+# disables).
+
+.PHONY: native native-test native-cmake test clean
+
+NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
+	-Wall -Wextra -fstack-protector-strong
+SAN ?=
+
+native:
+	mkdir -p torchdistx_tpu/_lib
+	g++ $(NATIVE_CXXFLAGS) $(SAN) -shared \
+	    -o torchdistx_tpu/_lib/libtdxgraph.so csrc/tdx_graph.cc
+
+native-test:
+	mkdir -p csrc/build
+	g++ $(NATIVE_CXXFLAGS) $(SAN) \
+	    -o csrc/build/test_graph csrc/tdx_graph.cc csrc/test_graph.cc
+	./csrc/build/test_graph
+
+native-cmake:
+	cmake -S csrc -B csrc/build -G Ninja
+	cmake --build csrc/build
+
+test:
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf csrc/build torchdistx_tpu/_lib

@@ -1,0 +1,99 @@
+// Native unit tests for the graph engine (run under ASan/UBSan in CI —
+// the runtime sanitizer coverage the reference lacked, its tests/cc was
+// an acknowledged TODO, reference CMakeLists.txt:104-106).
+//
+// Build/run: make native-test
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+void* tdx_graph_create();
+void tdx_graph_destroy(void*);
+uint64_t tdx_node_create(void*);
+void tdx_node_destroy(void*, uint64_t);
+uint64_t tdx_node_op_nr(void*, uint64_t);
+void tdx_node_add_storage(void*, uint64_t, uint64_t);
+void tdx_node_add_dep(void*, uint64_t, uint64_t, int32_t);
+void tdx_node_set_materialized(void*, uint64_t, int32_t);
+uint64_t tdx_last_in_place(void*, uint64_t);
+uint64_t tdx_build_call_stack(void*, uint64_t, uint64_t*, uint64_t);
+}
+
+static std::vector<uint64_t> stack_of(void* g, uint64_t id) {
+  uint64_t buf[64];
+  uint64_t n = tdx_build_call_stack(g, id, buf, 64);
+  assert(n <= 64);
+  return std::vector<uint64_t>(buf, buf + n);
+}
+
+int main() {
+  // Scenario from tests/test_deferred_init.py::test_in_place_through_view:
+  //   n1 = empty(w)        storage S
+  //   n2 = fill_(w)        storage S  (dep n1)
+  //   n3 = select(w)->v    storage S  (dep n2)
+  //   n4 = add_(v)         storage S  (dep n3)
+  //   n5 = mul_(w)         storage S  (dep n2!)  <- w's ctx was n2
+  void* g = tdx_graph_create();
+  uint64_t n1 = tdx_node_create(g);
+  uint64_t n2 = tdx_node_create(g);
+  uint64_t n3 = tdx_node_create(g);
+  uint64_t n4 = tdx_node_create(g);
+  uint64_t n5 = tdx_node_create(g);
+  const uint64_t S = 0xABCD;
+  for (uint64_t n : {n1, n2, n3, n4, n5}) tdx_node_add_storage(g, n, S);
+  tdx_node_add_dep(g, n2, n1, 0);
+  tdx_node_add_dep(g, n3, n2, 0);
+  tdx_node_add_dep(g, n4, n3, 0);
+  tdx_node_add_dep(g, n5, n2, 0);
+
+  // materialize(w) at n5: last in place is n5 itself; stack must include
+  // the view chain n3,n4 (they alias S) in chronological order.
+  assert(tdx_last_in_place(g, n5) == n5);
+  auto s = stack_of(g, n5);
+  assert((s == std::vector<uint64_t>{n1, n2, n3, n4, n5}));
+
+  // materialize(v) at n4: later mutation n5 is excluded (op_nr > last).
+  auto sv = stack_of(g, n4);
+  assert((sv == std::vector<uint64_t>{n1, n2, n3, n4}));
+
+  // last-in-place from the producer n2 must find n5.
+  assert(tdx_last_in_place(g, n2) == n5);
+
+  // Materialized nodes prune the dependency closure: with the whole
+  // prefix replayed (as a real materialize would have done — replayed
+  // real tensors carry alias state), only the requested node remains.
+  for (uint64_t n : {n1, n2, n3, n4}) tdx_node_set_materialized(g, n, 1);
+  auto sm = stack_of(g, n5);
+  assert((sm == std::vector<uint64_t>{n5}));
+
+  // node destruction erases back-edges: destroy n5, n2's dependents must
+  // no longer reach it.
+  tdx_node_destroy(g, n5);
+  assert(tdx_last_in_place(g, n4) == n4);
+
+  // clobbered reader: r reads storage A's output (no alias), then an
+  // in-place op clobbers A before the requested node.
+  //   a1 = empty (A); r = mm(a) -> storage R; a2 = mul_(a) (A, dep a1)
+  uint64_t a1 = tdx_node_create(g);
+  uint64_t r = tdx_node_create(g);
+  uint64_t a2 = tdx_node_create(g);
+  tdx_node_add_storage(g, a1, 0x1);
+  tdx_node_add_storage(g, r, 0x2);
+  tdx_node_add_storage(g, a2, 0x1);
+  tdx_node_add_dep(g, r, a1, 0);
+  tdx_node_add_dep(g, a2, a1, 0);
+  auto sc = stack_of(g, a2);
+  assert((sc == std::vector<uint64_t>{a1, r, a2}));  // r pulled in before a2
+
+  // buffer-too-small path returns the true count without overflow.
+  uint64_t tiny[1];
+  uint64_t need = tdx_build_call_stack(g, a2, tiny, 1);
+  assert(need == 3);
+
+  tdx_graph_destroy(g);
+  std::puts("native graph tests OK");
+  return 0;
+}

@@ -1,0 +1,63 @@
+"""Packaging for torchdistx_tpu.
+
+Mirrors the reference's custom-build approach (its setup.py wraps CMake,
+reference setup.py:43-136): the native graph engine (csrc/tdx_graph.cc)
+is compiled into the package's ``_lib`` directory at build time; the
+package remains fully functional without it (pure-Python fallback).
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import Command, find_packages, setup
+from setuptools.command.build_py import build_py
+
+ROOT = Path(__file__).parent
+
+
+class build_native(Command):
+    description = "build the native graph engine (libtdxgraph.so)"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        # Single source of truth for the compile flags: the Makefile.
+        subprocess.check_call(["make", "-C", str(ROOT), "native"])
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        try:
+            self.run_command("build_native")
+        except Exception as e:  # native is optional
+            print(f"warning: native build skipped ({e})")
+        super().run()
+
+
+setup(
+    name="torchdistx_tpu",
+    version="0.1.0.dev0",
+    description=(
+        "TPU-native fake tensors and deferred module initialization: "
+        "record init, materialize sharded into TPU HBM via XLA"
+    ),
+    packages=find_packages(include=["torchdistx_tpu", "torchdistx_tpu.*"]),
+    package_data={"torchdistx_tpu": ["_lib/*.so"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax>=0.4.30",
+        "flax>=0.8",
+        "optax",
+        "numpy",
+    ],
+    extras_require={
+        "torch": ["torch>=2.1", "transformers"],
+        "test": ["pytest"],
+    },
+    cmdclass={"build_native": build_native, "build_py": build_py_with_native},
+)

@@ -1,0 +1,70 @@
+"""Tests for aux subsystems: checkpoint round-trip (sharded), profiling
+timers, metrics sink."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu.parallel import make_mesh
+from torchdistx_tpu.utils import Metrics, StepTimer, Timer
+from torchdistx_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class TestCheckpoint:
+    def test_roundtrip_sharded(self, tmp_path):
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        x = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P("dp", "tp")),
+        )
+        state = {"params": {"w": x}, "step": jnp.int32(7)}
+        save_checkpoint(tmp_path / "ckpt", state)
+        restored = restore_checkpoint(tmp_path / "ckpt", target=state)
+        assert np.array_equal(np.asarray(restored["params"]["w"]), np.asarray(x))
+        assert int(restored["step"]) == 7
+        assert restored["params"]["w"].sharding.spec == P("dp", "tp")
+
+    def test_restore_into_different_sharding(self, tmp_path):
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        x = jax.device_put(
+            jnp.ones((8, 8)), NamedSharding(mesh, P("dp", "tp"))
+        )
+        save_checkpoint(tmp_path / "c2", {"w": x})
+        target = {
+            "w": jax.ShapeDtypeStruct(
+                (8, 8), jnp.float32, sharding=NamedSharding(mesh, P("tp", "dp"))
+            )
+        }
+        restored = restore_checkpoint(tmp_path / "c2", target=target)
+        assert restored["w"].sharding.spec == P("tp", "dp")
+        assert np.array_equal(np.asarray(restored["w"]), np.ones((8, 8)))
+
+
+class TestProfiling:
+    def test_timer_blocks(self):
+        with Timer() as t:
+            x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+            t.block_on(x)
+        assert t.elapsed is not None and t.elapsed > 0
+
+    def test_step_timer(self):
+        st = StepTimer()
+        for _ in range(3):
+            st.start()
+            st.stop(jnp.ones(4) + 1)
+        assert st.steps == 3 and st.mean > 0
+
+
+class TestMetrics:
+    def test_jsonl_sink(self, tmp_path):
+        m = Metrics(tmp_path / "m.jsonl")
+        m.log(1, loss=1.5, lr=1e-3)
+        m.log(2, loss=jnp.float32(1.25))
+        m.close()
+        lines = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+        assert lines[0]["loss"] == 1.5
+        assert lines[1]["loss"] == 1.25

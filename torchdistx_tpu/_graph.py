@@ -30,7 +30,6 @@ compile the same graph into an XLA program with sharded outputs.
 
 from __future__ import annotations
 
-import threading
 import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -46,17 +45,22 @@ from .fake import (
     del_fake_context,
 )
 
+import itertools
+
+from . import _native
+
 CONTEXT_KEY = "deferred_init"
 
-_tls = threading.local()
+_op_counter = itertools.count()
 
 
 def _next_op_nr() -> int:
-    # Monotone thread-local op number (deferred_init.cc:379, 668): replay
-    # order is chronological recording order.
-    nr = getattr(_tls, "op_nr", 0)
-    _tls.op_nr = nr + 1
-    return nr
+    # Monotone op number: replay order is chronological recording order.
+    # The reference's counter is thread-local (deferred_init.cc:379, 668),
+    # which leaves cross-thread recordings unordered; a process-global
+    # counter is a strict superset (still monotone within a thread) and
+    # makes interleaved recordings replay correctly.
+    return next(_op_counter)
 
 
 class _Dep:
@@ -130,11 +134,18 @@ class Op:
 
 
 class OpNode:
-    """A node of the replay DAG (deferred_init.cc:309-705)."""
+    """A node of the replay DAG (deferred_init.cc:309-705).
+
+    The graph *topology* (op_nr order, storage alias keys, dep/dependent
+    edges) is mirrored into the native C++ engine (csrc/tdx_graph.cc) when
+    it is built, and the hot graph walks delegate there; the pure-Python
+    implementation below remains the reference fallback (TDX_NATIVE=0).
+    """
 
     __slots__ = (
         "op", "op_nr", "storages", "dependencies", "dependents",
-        "argument_versions", "outputs", "materialized", "__weakref__",
+        "argument_versions", "outputs", "materialized",
+        "_ng", "_nid", "__weakref__",
     )
 
     def __init__(self, op: Op):
@@ -154,6 +165,45 @@ class OpNode:
         self.argument_versions: List[Tuple[torch.Tensor, int]] = []
         self.outputs: Optional[List[Any]] = None
         self.materialized = False
+        if _native.available():
+            self._ng = _native.NativeGraph.current()
+            self._nid = self._ng.node_create()
+            self._ng.py_nodes[self._nid] = weakref.ref(self)
+        else:
+            self._ng = None
+            self._nid = 0
+
+    def __del__(self):
+        # Mirror the reference's OpNode destructor: erase back-edges in
+        # the native graph (deferred_init.cc:409-411).
+        if self._ng is not None:
+            try:
+                self._ng.py_nodes.pop(self._nid, None)
+                self._ng.node_destroy(self._nid)
+            except Exception:
+                pass
+
+    def _native_sync_edges(self) -> None:
+        """Push dependencies/storages to the native mirror (called once,
+        after record_op fills them in).
+
+        A dependency recorded on another thread lives in a different
+        native graph; neither graph then has the full topology, so BOTH
+        are poisoned (their nodes fall back to the Python walks, which use
+        the process-global op_nr ordering and remain correct)."""
+        if self._ng is None:
+            return
+        foreign = [dep for dep, _ in self.dependencies if dep._ng is not self._ng]
+        if foreign:
+            self._ng.poisoned = True
+            for dep in foreign:
+                if dep._ng is not None:
+                    dep._ng.poisoned = True
+            return
+        for dep, idx in self.dependencies:
+            self._ng.add_dep(self._nid, dep._nid, idx)
+        for key in self.storages:
+            self._ng.add_storage(self._nid, key)
 
     # -- graph walks -----------------------------------------------------
 
@@ -188,7 +238,23 @@ class OpNode:
         input storage is clobbered by a later included in-place op (they
         must replay before the mutation or they can never replay
         correctly).
+
+        Delegates to the native engine when available; the Python code
+        below is the reference implementation (and the fallback).
         """
+        if self._ng is not None and not self._ng.poisoned:
+            ids = self._ng.build_call_stack(self._nid)
+            nodes = []
+            ok = True
+            for nid in ids:
+                ref = self._ng.py_nodes.get(nid)
+                n = ref() if ref is not None else None
+                if n is None:
+                    ok = False
+                    break
+                nodes.append(n)
+            if ok:
+                return nodes
         last = self.last_in_place_node()
         included: Dict[int, OpNode] = {}
 
@@ -338,6 +404,8 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
                 base_ctx.views.append(ctx)
         tensor_idx += 1
 
+    node._native_sync_edges()
+
 
 # ---------------------------------------------------------------------------
 # Replay (OpNode::materialize + detail::materialize,
@@ -439,6 +507,8 @@ def replay_node(node: OpNode, target: ReplayTarget) -> None:
     _flat(out)
     node.outputs = flat if flat else outputs
     node.materialized = True
+    if node._ng is not None:
+        node._ng.set_materialized(node._nid, True)
     node.detach_dependencies()
 
 

@@ -1,0 +1,116 @@
+"""ctypes bindings for the native graph engine (csrc/tdx_graph.cc).
+
+Loads ``torchdistx_tpu/_lib/libtdxgraph.so`` if present (built by
+``make native`` or setup.py); falls back cleanly when absent so the
+pure-Python graph walks in ``_graph.py`` remain the reference
+implementation.  Set ``TDX_NATIVE=0`` to force the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from pathlib import Path
+from typing import Optional
+
+_LIB_PATHS = [
+    Path(__file__).parent / "_lib" / "libtdxgraph.so",
+    Path(__file__).parent.parent / "csrc" / "build" / "libtdxgraph.so",
+]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("TDX_NATIVE", "1") == "0":
+        return None
+    for p in _LIB_PATHS:
+        if p.exists():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            lib.tdx_graph_create.restype = ctypes.c_void_p
+            lib.tdx_graph_destroy.argtypes = [ctypes.c_void_p]
+            lib.tdx_node_create.argtypes = [ctypes.c_void_p]
+            lib.tdx_node_create.restype = ctypes.c_uint64
+            lib.tdx_node_destroy.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.tdx_node_op_nr.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.tdx_node_op_nr.restype = ctypes.c_uint64
+            lib.tdx_node_add_storage.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.tdx_node_add_dep.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32,
+            ]
+            lib.tdx_node_set_materialized.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32,
+            ]
+            lib.tdx_last_in_place.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.tdx_last_in_place.restype = ctypes.c_uint64
+            lib.tdx_build_call_stack.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ]
+            lib.tdx_build_call_stack.restype = ctypes.c_uint64
+            return lib
+    return None
+
+
+LIB = _load()
+
+
+def available() -> bool:
+    return LIB is not None
+
+
+class NativeGraph:
+    """One native graph per thread (op_nr ordering is thread-local, like
+    the reference's TLS counter, deferred_init.cc:668)."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self.handle = ctypes.c_void_p(LIB.tdx_graph_create())
+        # nid -> weakref(OpNode); entries removed by OpNode.__del__.
+        self.py_nodes = {}
+        # Set when a cross-thread dependency makes this graph's topology
+        # incomplete; walks then fall back to the Python implementation.
+        self.poisoned = False
+
+    def __del__(self):
+        if LIB is not None and getattr(self, "handle", None):
+            LIB.tdx_graph_destroy(self.handle)
+
+    @classmethod
+    def current(cls) -> "NativeGraph":
+        g = getattr(cls._tls, "graph", None)
+        if g is None:
+            g = cls()
+            cls._tls.graph = g
+        return g
+
+    # -- node ops ---------------------------------------------------------
+
+    def node_create(self) -> int:
+        return LIB.tdx_node_create(self.handle)
+
+    def node_destroy(self, nid: int) -> None:
+        LIB.tdx_node_destroy(self.handle, nid)
+
+    def add_storage(self, nid: int, key: int) -> None:
+        LIB.tdx_node_add_storage(self.handle, nid, key & 0xFFFFFFFFFFFFFFFF)
+
+    def add_dep(self, nid: int, dep: int, out_idx: int) -> None:
+        LIB.tdx_node_add_dep(self.handle, nid, dep, out_idx)
+
+    def set_materialized(self, nid: int, value: bool) -> None:
+        LIB.tdx_node_set_materialized(self.handle, nid, 1 if value else 0)
+
+    def build_call_stack(self, nid: int) -> list:
+        cap = 256
+        while True:
+            buf = (ctypes.c_uint64 * cap)()
+            n = LIB.tdx_build_call_stack(self.handle, nid, buf, cap)
+            if n <= cap:
+                return [buf[i] for i in range(n)]
+            cap = n

@@ -310,6 +310,9 @@ TABLE["aten.div.Scalar"] = ("pure", _div_pure)
 TABLE["aten.div.Tensor_mode"] = ("pure", _div_pure)
 TABLE["aten.div.Scalar_mode"] = ("pure", _div_pure)
 TABLE["aten.pow.Tensor_Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
+TABLE["aten.pow.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
+TABLE["aten.pow.Tensor_Tensor"] = ("pure", _binop_pure(lambda a, b, al: a**b))
+TABLE["aten.pow_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a**b))
 
 for name, fn in {
     "aten.neg.default": lambda x: -x,

@@ -1,0 +1,6 @@
+"""Auxiliary subsystems: checkpoint/resume, profiling, logging/metrics."""
+
+from .logging import Metrics, get_logger
+from .profiling import StepTimer, Timer, annotate, trace
+
+__all__ = ["Metrics", "get_logger", "StepTimer", "Timer", "annotate", "trace"]

@@ -1,0 +1,60 @@
+"""Sharded checkpoint / resume (orbax-backed).
+
+The reference has no checkpointing at all — its op graph is in-memory
+only, with type-erased closures that cannot serialize (SURVEY.md §5,
+deferred_init.cc:165).  The TPU framework closes that gap at the right
+level: recordings themselves stay ephemeral (they are cheap to re-record
+from config), while *materialized, sharded training state* checkpoints
+through orbax with each host writing only its own shards, and restores
+directly into the target sharding layout (so a resume can change mesh
+shape).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAS_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAS_ORBAX = False
+
+
+def _require_orbax():
+    if not _HAS_ORBAX:
+        raise RuntimeError("orbax-checkpoint is not installed.")
+
+
+def save_checkpoint(path: str | Path, state: Any, *, force: bool = True) -> None:
+    """Save a pytree of (possibly sharded) jax.Arrays."""
+    _require_orbax()
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=force)
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(
+    path: str | Path,
+    *,
+    target: Optional[Any] = None,
+) -> Any:
+    """Restore; if ``target`` is a pytree of ShapeDtypeStruct with
+    shardings (or of arrays), values land directly in that layout."""
+    _require_orbax()
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape")
+            else x,
+            target,
+        )
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)

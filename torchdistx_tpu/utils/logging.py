@@ -1,0 +1,56 @@
+"""Structured logging + lightweight metrics.
+
+The reference's only observability surface is the fake-tensor repr patch
+(SURVEY.md §5); this module provides the framework-level logger plus a
+minimal metrics sink usable from training loops (counters/gauges with
+JSON-lines export — no external deps)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+_LOGGER: Optional[logging.Logger] = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger("torchdistx_tpu")
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(
+                logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+            )
+            logger.addHandler(h)
+            logger.setLevel(logging.INFO)
+        _LOGGER = logger
+    return _LOGGER
+
+
+class Metrics:
+    """Append-only metric sink writing JSON lines (one record per log)."""
+
+    def __init__(self, path: Optional[str | Path] = None):
+        self.path = Path(path) if path else None
+        self._fh = open(self.path, "a") if self.path else None
+
+    def log(self, step: int, **values: Any) -> Dict[str, Any]:
+        rec = {"ts": time.time(), "step": step}
+        for k, v in values.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = str(v)
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        return rec
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()

@@ -1,0 +1,82 @@
+"""Profiling / tracing hooks.
+
+The reference ships no profiling (SURVEY.md §5 — "No timing/profiling
+anywhere"); here the XLA-level story is first-class: ``trace`` wraps
+``jax.profiler`` (view in TensorBoard/XProf), ``annotate`` adds named
+regions to device timelines, and ``Timer`` covers host-side wall timing
+with block-until-ready semantics so compiled-async dispatch does not lie.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XLA profile for the enclosed region."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region on the device timeline (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Wall-clock timer that waits for async device work.
+
+    >>> with Timer() as t:
+    ...     out = step(state, batch)
+    ...     t.block_on(out)
+    >>> t.elapsed
+    """
+
+    def __init__(self):
+        self.elapsed: Optional[float] = None
+        self._blocked: Any = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def block_on(self, value: Any) -> Any:
+        self._blocked = value
+        return value
+
+    def __exit__(self, *exc) -> None:
+        if self._blocked is not None:
+            jax.block_until_ready(self._blocked)
+        self.elapsed = time.perf_counter() - self._t0
+
+
+class StepTimer:
+    """Running throughput stats for a training loop."""
+
+    def __init__(self):
+        self.steps = 0
+        self.total = 0.0
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, result: Any = None) -> float:
+        if result is not None:
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self._t0
+        self.steps += 1
+        self.total += dt
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return self.total / max(1, self.steps)
