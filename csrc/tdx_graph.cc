@@ -180,13 +180,6 @@ TDX_API void tdx_node_destroy(void* gp, uint64_t id) {
   g.nodes.erase(id);
 }
 
-TDX_API uint64_t tdx_node_op_nr(void* gp, uint64_t id) {
-  Graph& g = *static_cast<Graph*>(gp);
-  std::lock_guard<std::mutex> lock(g.mu);
-  Node* n = g.get(id);
-  return n ? n->op_nr : 0;
-}
-
 TDX_API void tdx_node_add_storage(void* gp, uint64_t id, uint64_t key) {
   Graph& g = *static_cast<Graph*>(gp);
   std::lock_guard<std::mutex> lock(g.mu);

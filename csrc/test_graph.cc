@@ -14,7 +14,6 @@ void* tdx_graph_create();
 void tdx_graph_destroy(void*);
 uint64_t tdx_node_create(void*);
 void tdx_node_destroy(void*, uint64_t);
-uint64_t tdx_node_op_nr(void*, uint64_t);
 void tdx_node_add_storage(void*, uint64_t, uint64_t);
 void tdx_node_add_dep(void*, uint64_t, uint64_t, int32_t);
 void tdx_node_set_materialized(void*, uint64_t, int32_t);

@@ -144,6 +144,17 @@ class TestCompile:
         assert np.array_equal(a, b)
         assert not np.array_equal(a, c)
 
+    def test_rng_independent_of_prior_recordings(self):
+        # RNG keys are session-relative: the same recording under the same
+        # seed yields the same values no matter what the process recorded
+        # before (keys fold the per-session op number, not the raw global
+        # ordering counter).
+        make = lambda: torch.empty(64).uniform_()
+        a = np.asarray(materialize_tensor_jax(deferred_init(make), seed=3))
+        deferred_init(lambda: torch.zeros(7).add_(1))  # unrelated recording
+        b = np.asarray(materialize_tensor_jax(deferred_init(make), seed=3))
+        assert np.array_equal(a, b)
+
 
 class TestShardedMaterialize:
     def test_out_sharding(self, mesh):

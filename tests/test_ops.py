@@ -1,0 +1,149 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh).
+
+Compares the flash-attention kernel against the plain XLA attention in
+``models.layers`` — same math, different schedule — across the axes that
+change the kernel's control flow: causality, GQA grouping, ragged sequence
+lengths (padding masks), and dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchdistx_tpu.models.layers import default_attention
+from torchdistx_tpu.ops import flash_attention, make_flash_attention
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_xla_attention(causal):
+    B, S, H, D = 2, 64, 4, 16
+    q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), _rand((B, S, H, D), 2)
+    ref = default_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+def test_gqa_grouping():
+    # 8 query heads over 2 kv heads: the kernel's index maps must route each
+    # query head to its group's K/V, not broadcast.
+    B, S, H, KV, D = 1, 32, 8, 2, 16
+    q = _rand((B, S, H, D), 0)
+    k, v = _rand((B, S, KV, D), 1), _rand((B, S, KV, D), 2)
+    ref = default_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+def test_ragged_seq_len_padding():
+    # 50 is not a multiple of the 16-wide blocks: padded key positions must
+    # be masked out, padded query rows sliced off.
+    B, S, H, D = 1, 50, 2, 16
+    q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), _rand((B, S, H, D), 2)
+    for causal in (True, False):
+        ref = default_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_cross_lengths_suffix_alignment(causal):
+    # S != T: default_attention aligns the last query with the last key
+    # (tril offset k=T-S); the kernel must match, fwd and bwd.
+    B, S, T, H, D = 1, 24, 64, 2, 16
+    q = _rand((B, S, H, D), 0)
+    k, v = _rand((B, T, H, D), 1), _rand((B, T, H, D), 2)
+    ref = default_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, causal=causal)))
+
+    flash = lambda q, k, v, causal: flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16
+    )
+    gf = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(default_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.max(jnp.abs(a - b)) < 1e-5
+
+
+def test_mismatched_head_counts_raise():
+    B, S, D = 1, 16, 8
+    q = _rand((B, S, 8, D), 0)
+    k, v = _rand((B, S, 3, D), 1), _rand((B, S, 3, D), 2)
+    with pytest.raises(ValueError, match="multiple of KV heads"):
+        flash_attention(q, k, v)
+
+
+def test_bfloat16():
+    B, S, H, D = 1, 32, 2, 16
+    q = _rand((B, S, H, D), 0, jnp.bfloat16)
+    k = _rand((B, S, H, D), 1, jnp.bfloat16)
+    v = _rand((B, S, H, D), 2, jnp.bfloat16)
+    ref = default_attention(q, k, v, causal=True).astype(jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16).astype(
+        jnp.float32
+    )
+    assert jnp.max(jnp.abs(ref - out)) < 3e-2
+
+
+def test_bias_falls_back_to_xla():
+    B, S, H, D = 1, 16, 2, 8
+    q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), _rand((B, S, H, D), 2)
+    bias = _rand((H, S, S), 3)
+    ref = default_attention(q, k, v, causal=False, bias=bias)
+    out = flash_attention(q, k, v, causal=False, bias=bias)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_xla(causal):
+    B, S, H, D = 1, 48, 2, 16
+    q, k, v = _rand((B, S, H, D), 0), _rand((B, S, H, D), 1), _rand((B, S, H, D), 2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, causal=causal)))
+
+    flash = lambda q, k, v, causal: flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16
+    )
+    gf = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(default_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.max(jnp.abs(a - b)) < 1e-5
+
+
+def test_gradients_gqa_group_reduction():
+    # dk/dv must sum over the query heads of each kv group.
+    B, S, H, KV, D = 1, 32, 4, 2, 16
+    q = _rand((B, S, H, D), 0)
+    k, v = _rand((B, S, KV, D), 1), _rand((B, S, KV, D), 2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, causal=True)))
+
+    flash = lambda q, k, v, causal: flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16
+    )
+    gf = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(default_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.max(jnp.abs(a - b)) < 1e-5
+
+
+def test_as_model_attn_fn():
+    # A whole model family runs on the kernel by constructor argument.
+    from torchdistx_tpu.models import TINY, make_llama
+
+    attn = make_flash_attention(block_q=16, block_k=16)
+    model = make_llama(TINY, attn_fn=attn)
+    toks = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    logits = model.apply(params, toks)
+    assert logits.shape == (1, 16, TINY.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))

@@ -30,6 +30,7 @@ compile the same graph into an XLA program with sharded outputs.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -61,6 +62,28 @@ def _next_op_nr() -> int:
     # counter is a strict superset (still monotone within a thread) and
     # makes interleaved recordings replay correctly.
     return next(_op_counter)
+
+
+# Session-relative numbering for RNG-key derivation. The global op_nr is
+# only an *ordering*; its raw value depends on everything recorded before
+# (other threads, earlier models), so the jax bridge must not fold it into
+# RNG keys. Each top-level deferred-init session numbers its ops 0..n on a
+# thread-local counter: the same model recorded under the same seed yields
+# the same parameters no matter what else this process recorded.
+_session_tls = threading.local()
+
+
+def begin_recording_session() -> None:
+    _session_tls.counter = itertools.count()
+
+
+def end_recording_session() -> None:
+    _session_tls.counter = None
+
+
+def _next_key_nr(op_nr: int) -> int:
+    counter = getattr(_session_tls, "counter", None)
+    return next(counter) if counter is not None else op_nr
 
 
 class _Dep:
@@ -143,7 +166,7 @@ class OpNode:
     """
 
     __slots__ = (
-        "op", "op_nr", "storages", "dependencies", "dependents",
+        "op", "op_nr", "key_nr", "storages", "dependencies", "dependents",
         "argument_versions", "outputs", "materialized",
         "_ng", "_nid", "__weakref__",
     )
@@ -151,6 +174,7 @@ class OpNode:
     def __init__(self, op: Op):
         self.op = op
         self.op_nr = _next_op_nr()
+        self.key_nr = _next_key_nr(self.op_nr)
         # Meta storages of fake outputs: the alias/in-place detection key
         # (deferred_init.cc:384, 413-425).
         self.storages: Set[int] = set()

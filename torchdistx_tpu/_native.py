@@ -34,8 +34,6 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.tdx_node_create.argtypes = [ctypes.c_void_p]
             lib.tdx_node_create.restype = ctypes.c_uint64
             lib.tdx_node_destroy.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-            lib.tdx_node_op_nr.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-            lib.tdx_node_op_nr.restype = ctypes.c_uint64
             lib.tdx_node_add_storage.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
             ]

@@ -99,7 +99,15 @@ class DeferredInitMode(TorchDispatchMode):
         return out
 
 
-_deferred_toggle = ModeToggle(DeferredInitMode, "Deferred-init mode")
+# Top-level enable starts a fresh recording session: ops are numbered
+# 0..n per session so jax-bridge RNG keys are reproducible regardless of
+# what this process recorded before (see _graph.begin_recording_session).
+_deferred_toggle = ModeToggle(
+    DeferredInitMode,
+    "Deferred-init mode",
+    on_first_enable=_graph.begin_recording_session,
+    on_last_disable=_graph.end_recording_session,
+)
 
 
 def enable_deferred_init(enabled: bool) -> None:

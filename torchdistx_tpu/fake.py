@@ -421,9 +421,11 @@ class ModeToggle:
     init (``enableDeferredInit``, deferred_init.cc:1140-1160).
     """
 
-    def __init__(self, mode_cls, name: str):
+    def __init__(self, mode_cls, name: str, on_first_enable=None, on_last_disable=None):
         self._mode_cls = mode_cls
         self._name = name
+        self._on_first_enable = on_first_enable
+        self._on_last_disable = on_last_disable
         self._tls = threading.local()
 
     def _stack(self):
@@ -434,6 +436,8 @@ class ModeToggle:
     def set(self, enabled: bool) -> None:
         stack = self._stack()
         if enabled:
+            if not stack and self._on_first_enable is not None:
+                self._on_first_enable()
             mode = self._mode_cls()
             stack.append(mode)
             mode.__enter__()
@@ -441,6 +445,8 @@ class ModeToggle:
             if not stack:
                 raise RuntimeError(f"{self._name} is not enabled.")
             stack.pop().__exit__(None, None, None)
+            if not stack and self._on_last_disable is not None:
+                self._on_last_disable()
 
 
 _fake_toggle = ModeToggle(FakeMode, "Fake mode")

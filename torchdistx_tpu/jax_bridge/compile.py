@@ -133,7 +133,10 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         )
     kind, impl = entry
 
-    ctx.current_op_nr = node.op_nr
+    # key_nr, not op_nr: RNG keys must be session-relative so the same
+    # recording yields the same parameters regardless of what else the
+    # process recorded before (see _graph.begin_recording_session).
+    ctx.current_op_nr = node.key_nr
     args = node.op.args
     kwargs = {k: v for k, v in node.op.kwargs.items() if k not in _STRIP_KWARGS and v is not None}
     # Positional device/generator-like leaves are stripped by type.

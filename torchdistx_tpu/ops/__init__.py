@@ -1,0 +1,10 @@
+"""TPU kernels (pallas) for the hot ops.
+
+The compute path of this framework is JAX/XLA; where XLA's fusions are not
+enough, ops here drop to hand-written pallas TPU kernels. Every kernel has
+an interpret-mode path so the full test suite runs on CPU.
+"""
+
+from .flash_attention import flash_attention, make_flash_attention
+
+__all__ = ["flash_attention", "make_flash_attention"]

@@ -1,0 +1,472 @@
+"""Blockwise (flash) attention as pallas TPU kernels, forward + backward.
+
+The hot op of every model family here is attention; XLA's default lowering
+materializes the [S, T] logits in HBM. These kernels stream K/V blocks
+through VMEM with the online-softmax recurrence, so per-core memory is
+O(block_q x block_k) regardless of sequence length — the standard
+FlashAttention scheme laid out for the TPU memory hierarchy:
+
+* grid = (batch x heads, outer blocks, inner blocks); TPU grids run
+  sequentially, so VMEM scratch accumulators carry across the innermost
+  dimension and are re-initialized when its index wraps to 0;
+* all block matmuls run on the MXU with float32 accumulation
+  (``preferred_element_type``), everything else rides the VPU;
+* GQA/MQA is handled in the index maps — K/V blocks are fetched from the
+  kv-head their query head belongs to, never broadcast in HBM, in the
+  backward too: the dk/dv kernel's innermost grid dimension iterates the
+  (group head, q block) product and accumulates group contributions in
+  VMEM scratch (layout identity: query head row ``b*H + kv*G + g`` ==
+  ``bkv*G + g`` for ``bkv = b*KV + kv``);
+* causal + length masking follows ``default_attention``'s convention
+  (last query aligned with last key: query i sees keys j <= i + T - S);
+  blocks entirely on the wrong side of the diagonal skip their FLOPs via
+  ``pl.when``;
+* the backward pass is the two-kernel scheme: a dq kernel (k innermost)
+  and a dk/dv kernel ((g, q) innermost), both recomputing block
+  probabilities from the saved per-row logsumexp instead of storing the
+  S x T matrix.
+
+Matches the model layer ``AttnFn`` signature (`models/layers.py`), so any
+family runs on it by constructor argument, including under `jax.grad`.
+On non-TPU backends the kernels run in interpreter mode, which keeps the
+CPU test suite meaningful.
+
+Additive bias (T5 relative position) falls back to the XLA path — a
+bias-aware kernel needs one more operand stream and is not the common
+case for the long-context families this targets.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_LANES = 128  # TPU lane width: scratch vectors are carried at full lanes
+
+
+def _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal):
+    """Valid-key mask for one block, in default_attention's convention."""
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_len_k  # padded keys never attend
+    if causal:
+        mask = jnp.logical_and(mask, k_pos <= q_pos + offset)
+    return mask
+
+
+def _block_needed(q_start, k_start, block_q, offset, causal):
+    """False only for blocks with no (q, k) pair on the causal side."""
+    return jnp.logical_or(
+        jnp.logical_not(causal), k_start <= q_start + (block_q - 1) + offset
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,  # [1, block_q, D]
+    k_ref,  # [1, block_k, D]
+    v_ref,  # [1, block_k, D]
+    o_ref,  # [1, block_q, D]
+    lse_ref,  # [1, block_q, 1]
+    acc_ref,  # VMEM [block_q, D] f32
+    m_ref,  # VMEM [block_q, _LANES] f32
+    l_ref,  # VMEM [block_q, _LANES] f32
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_len_k: int,
+    offset: int,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    @pl.when(_block_needed(q_start, k_start, block_q, offset, causal))
+    def _block():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
+        k = k_ref[0].astype(jnp.float32)  # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        mask = _causal_mask(
+            q_start, k_start, block_q, block_k, seq_len_k, offset, causal
+        )
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [bq, bk]
+
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p,
+            v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, D]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+
+
+# ---------------------------------------------------------------------------
+# backward: dq (k innermost), then dk/dv ((group, q) innermost)
+# ---------------------------------------------------------------------------
+
+
+def _block_p_ds(
+    q, k, lse, do, v, delta, *, causal, sm_scale, q_start, k_start, seq_len_k,
+    offset, block_q, block_k,
+):
+    """Recompute one block's probabilities and d(logits) from residuals.
+
+    p  = exp(q k^T * scale - lse)         [bq, bk]
+    ds = p * (do v^T - delta) * scale     (gradient of the raw logits)
+    """
+    s = jax.lax.dot_general(
+        q * sm_scale, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    mask = _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    ds = p * (dp - delta[:, None]) * sm_scale
+    return p, ds
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_acc,  # VMEM [block_q, D] f32
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_len_k: int,
+    offset: int,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start, k_start = qi * block_q, kj * block_k
+
+    @pl.when(_block_needed(q_start, k_start, block_q, offset, causal))
+    def _block():
+        _, ds = _block_p_ds(
+            q_ref[0].astype(jnp.float32),
+            k_ref[0].astype(jnp.float32),
+            lse_ref[0, :, 0],
+            do_ref[0].astype(jnp.float32),
+            v_ref[0].astype(jnp.float32),
+            delta_ref[0, :, 0],
+            causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
+            seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds,
+            k_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc,  # VMEM [block_k, D] f32
+    dv_acc,  # VMEM [block_k, D] f32
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_len_k: int,
+    offset: int,
+    groups: int,
+):
+    """Grid (B*KV, nk, groups*nq): the innermost dimension walks every
+    (group head, q block) pair of this kv head, accumulating dk/dv in
+    VMEM — GQA needs no K/V broadcast or post-hoc group reduction."""
+    kj = pl.program_id(1)
+    it = pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    nq = n_inner // groups
+    qi = it % nq
+
+    @pl.when(it == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, k_start = qi * block_q, kj * block_k
+
+    @pl.when(_block_needed(q_start, k_start, block_q, offset, causal))
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _block_p_ds(
+            q,
+            k_ref[0].astype(jnp.float32),
+            lse_ref[0, :, 0],
+            do,
+            v_ref[0].astype(jnp.float32),
+            delta_ref[0, :, 0],
+            causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
+            seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(it == n_inner - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+
+def _pad_seq(x: jax.Array, block: int) -> jax.Array:
+    """Zero-pad axis 1 (sequence / row dim) up to a multiple of ``block``."""
+    pad = (-x.shape[1]) % block
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[1] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _round8(n: int) -> int:
+    return max(8, ((n + 7) // 8) * 8)
+
+
+def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
+    BH, S, D = qh.shape
+    T = kh.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+    qp = _pad_seq(qh, block_q)
+    kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
+        ),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(qp.shape, qh.dtype),
+            jax.ShapeDtypeStruct((BH, qp.shape[1], 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :S], lse  # lse stays padded; backward re-pads to match
+
+
+def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret):
+    BH, S, D = qh.shape
+    T = kh.shape[1]
+    BKV = kh.shape[0]
+    sm_scale = 1.0 / math.sqrt(D)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qp, dop = _pad_seq(qh, block_q), _pad_seq(do, block_q)
+    kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
+    dp, lsep = _pad_seq(delta[..., None], block_q), lse  # lse padded by fwd
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+
+    common = dict(
+        causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
+    )
+    qspec = pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(BH, nq, nk),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+            qspec,
+            rowspec,
+            rowspec,
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, qh.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dp)
+
+    # Query-head row for (kv head bkv, group g) is bkv*groups + g; the
+    # innermost grid dim packs (g, qi) as it = g*nq + qi.
+    kspec = pl.BlockSpec((1, block_k, D), lambda bkv, kj, it: (bkv, kj, 0))
+    qspec2 = pl.BlockSpec(
+        (1, block_q, D), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
+    )
+    rowspec2 = pl.BlockSpec(
+        (1, block_q, 1), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, groups=groups, **common),
+        grid=(BKV, nk, groups * nq),
+        in_specs=[qspec2, kspec, kspec, qspec2, rowspec2, rowspec2],
+        out_specs=(kspec, kspec),
+        out_shape=(
+            jax.ShapeDtypeStruct(kp.shape, kh.dtype),
+            jax.ShapeDtypeStruct(vp.shape, vh.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dp)
+
+    return dq[:, :S], dk[:, :T], dv[:, :T]
+
+
+# ---------------------------------------------------------------------------
+# differentiable core ([B*H, S, D] layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(qh, kh, vh, groups, causal, block_q, block_k, interpret):
+    out, _ = _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_core_fwd(qh, kh, vh, groups, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret)
+    return out, (qh, kh, vh, out, lse)
+
+
+def _flash_core_bwd(groups, causal, block_q, block_k, interpret, res, do):
+    qh, kh, vh, out, lse = res
+    return _bwd_call(
+        qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret
+    )
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API (model AttnFn layout [B, S, H, D])
+# ---------------------------------------------------------------------------
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, KV, D]
+    v: jax.Array,  # [B, T, KV, D]
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention with the model ``AttnFn`` signature (GQA-aware,
+    differentiable via pallas backward kernels).
+
+    ``bias`` (relative-position models) falls back to the XLA path.
+    """
+    if bias is not None:
+        from ..models.layers import default_attention
+
+        return default_attention(q, k, v, causal=causal, bias=bias)
+
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    if H % KV:
+        raise ValueError(
+            f"Query heads ({H}) must be a multiple of KV heads ({KV})."
+        )
+    groups = H // KV
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = min(block_q, _round8(S))
+    bk = min(block_k, _round8(T))
+
+    # [B, S, H, D] -> [B*H, S, D]; KV heads stay un-broadcast, the kernel's
+    # index maps route each query head to its kv group.
+    qh = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * KV, T, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * KV, T, D)
+    out = _flash_core(qh, kh, vh, groups, causal, bq, bk, interpret)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(*, block_q: int = 512, block_k: int = 512):
+    """An ``AttnFn`` with fixed block sizes, for model constructors."""
+
+    def attn_fn(q, k, v, *, causal=True, bias=None):
+        return flash_attention(
+            q, k, v, causal=causal, bias=bias, block_q=block_q, block_k=block_k
+        )
+
+    return attn_fn

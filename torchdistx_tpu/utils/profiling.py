@@ -55,6 +55,7 @@ class Timer:
     def __exit__(self, *exc) -> None:
         if self._blocked is not None:
             jax.block_until_ready(self._blocked)
+            self._blocked = None  # don't pin device arrays past the scope
         self.elapsed = time.perf_counter() - self._t0
 
 
