@@ -23,6 +23,7 @@ from torchdistx_tpu.parallel import make_mesh
 from torchdistx_tpu.parallel.pipeline import pipelined_decoder_apply
 from torchdistx_tpu.parallel.ring_attention import make_ring_attention
 from torchdistx_tpu.parallel.train import make_train_step
+from torchdistx_tpu.parallel.ulysses import make_ulysses_attention
 
 
 class TestRingAttention:
@@ -68,6 +69,66 @@ class TestRingAttention:
         ref = plain.apply(params, toks)
         out = jax.jit(lambda p, t: ringed.apply(p, t))(params, toks)
         assert float(jnp.abs(ref - out).max()) < 2e-4
+
+
+class TestUlyssesAttention:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return make_mesh({"dp": 2, "sp": 4})
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, mesh, causal):
+        B, S, H, KV, D = 2, 32, 8, 4, 16  # KV=4 == sp size: kv heads split
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (B, S, H, D))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (B, S, KV, D))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (B, S, KV, D))
+        uly = make_ulysses_attention(mesh)
+        ref = default_attention(q, k, v, causal=causal)
+        out = jax.jit(lambda q, k, v: uly(q, k, v, causal=causal))(q, k, v)
+        assert float(jnp.abs(ref - out).max()) < 1e-5
+
+    def test_gqa_kv_broadcast_fallback(self, mesh):
+        # KV=2 does not divide sp=4: kv heads are broadcast to H internally.
+        B, S, H, KV, D = 2, 16, 8, 2, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, KV, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, KV, D))
+        uly = make_ulysses_attention(mesh)
+        ref = default_attention(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: uly(q, k, v, causal=True))(q, k, v)
+        assert float(jnp.abs(ref - out).max()) < 1e-5
+
+    def test_gradients_flow(self, mesh):
+        B, S, H, D = 2, 16, 4, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D))
+        uly = make_ulysses_attention(mesh)
+        g = jax.jit(jax.grad(lambda q: (uly(q, k, v) ** 2).sum()))(q)
+        assert bool(jnp.isfinite(g).all())
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_head_count_must_divide(self, mesh):
+        uly = make_ulysses_attention(mesh)
+        x = jnp.ones((1, 8, 6, 4))  # 6 heads, sp=4
+        with pytest.raises(ValueError, match="divide query heads"):
+            uly(x, x, x)
+
+    def test_no_sp_axis_degrades(self):
+        mesh = make_mesh({"dp": 8})
+        uly = make_ulysses_attention(mesh)
+        B, S, H, D = 1, 16, 4, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+        ref = default_attention(q, q, q, causal=True)
+        assert float(jnp.abs(uly(q, q, q, causal=True) - ref).max()) < 1e-6
+
+    def test_model_runs_with_ulysses(self, mesh):
+        model = make_llama(TINY, attn_fn=make_ulysses_attention(mesh))
+        toks = jnp.zeros((2, 32), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)
+        logits = jax.jit(model.apply)(params, toks)
+        assert logits.shape == (2, 32, TINY.vocab_size)
 
 
 class TestPipeline:

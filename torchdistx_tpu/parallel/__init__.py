@@ -2,7 +2,9 @@
 parallel attention/pipeline/MoE building blocks."""
 
 from .mesh import make_mesh, single_device_mesh
+from .ring_attention import make_ring_attention
 from .sharding import CallableShardingPlan, ShardingPlan, fsdp_plan
+from .ulysses import make_ulysses_attention
 
 __all__ = [
     "make_mesh",
@@ -10,4 +12,6 @@ __all__ = [
     "ShardingPlan",
     "CallableShardingPlan",
     "fsdp_plan",
+    "make_ring_attention",
+    "make_ulysses_attention",
 ]

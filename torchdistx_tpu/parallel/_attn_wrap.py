@@ -1,0 +1,51 @@
+"""shard_map scaffolding shared by the sequence-parallel attention wrappers
+(`ring_attention.make_ring_attention`, `ulysses.make_ulysses_attention`)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def wrap_seq_parallel_attn(
+    mesh: Mesh,
+    *,
+    name: str,
+    spec: P,
+    per_device: Callable,  # (q, k, v, causal) -> out, runs inside shard_map
+    validate: Optional[Callable] = None,  # (q, k, v) -> None, raises on misuse
+):
+    """Build a model-facing ``AttnFn`` that shard_maps ``per_device``.
+
+    Global [B, S, H, D] arrays are partitioned by ``spec``; one shard_map
+    is built per causality so the mapped callable stays jit-cacheable.
+    Additive bias is rejected here — it cannot be resharded correctly by
+    either strategy.
+    """
+
+    def _build(causal: bool):
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        def _sharded(q, k, v):
+            return per_device(q, k, v, causal)
+
+        return _sharded
+
+    fns = {True: _build(True), False: _build(False)}
+
+    def attn_fn(q, k, v, *, causal=True, bias=None):
+        if bias is not None:
+            raise NotImplementedError(f"{name} does not support bias")
+        if validate is not None:
+            validate(q, k, v)
+        return fns[causal](q, k, v)
+
+    return attn_fn

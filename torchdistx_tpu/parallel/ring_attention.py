@@ -19,15 +19,14 @@ Two entry points:
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from ._attn_wrap import wrap_seq_parallel_attn
 from .collectives import ppermute_next
 
 _NEG = -1e30
@@ -108,27 +107,12 @@ def make_ring_attention(
         return default_attention
     b = tuple(a for a in batch_axes if a in present) or None
     h = tuple(a for a in head_axes if a in present) or None
-    sp = seq_axis
-    spec = P(b, sp, h, None)
 
-    def _build(causal: bool):
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            check_vma=False,
-        )
-        def _sharded(q, k, v):
-            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
-
-        return _sharded
-
-    fns = {True: _build(True), False: _build(False)}
-
-    def attn_fn(q, k, v, *, causal=True, bias=None):
-        if bias is not None:
-            raise NotImplementedError("ring attention does not support bias")
-        return fns[causal](q, k, v)
-
-    return attn_fn
+    return wrap_seq_parallel_attn(
+        mesh,
+        name="ring attention",
+        spec=P(b, seq_axis, h, None),
+        per_device=lambda q, k, v, causal: ring_attention(
+            q, k, v, axis_name=seq_axis, causal=causal
+        ),
+    )

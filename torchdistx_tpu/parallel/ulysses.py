@@ -1,0 +1,116 @@
+"""Ulysses attention: all-to-all sequence parallelism over the ``sp`` axis.
+
+The second of the two long-context strategies (ring attention in
+``ring_attention.py`` is the other). DeepSpeed-Ulysses style: activations
+arrive sequence-sharded [B, S/n, H, D]; one all-to-all re-shards them
+head-wise to [B, S, H/n, D], each device runs *full-sequence* attention
+over its head slice with any local ``AttnFn`` (the pallas flash kernel by
+default), and a second all-to-all restores sequence sharding.
+
+Trade-offs vs the ring (why both exist):
+
+* Ulysses runs unmodified attention math locally — exact softmax, and it
+  composes with the pallas flash kernel's VMEM streaming — at the cost of
+  four all-to-alls (~4*B*S*H*D/n moved per device per call);
+* the ring rotates K/V via ``ppermute`` (~2*B*S*KV*D per device), so the
+  bandwidth ratio is n*KV/(2H): the ring moves less only when the GQA
+  ratio H/KV exceeds n/2 — for MHA the ring moves *more*;
+* Ulysses's parallel width is capped by head count (n must divide H); the
+  ring is capped only by sequence length, and owns its softmax
+  accumulation instead of reusing the local kernel's.
+
+Collectives are ``lax.all_to_all`` over a named mesh axis inside
+``shard_map`` — on TPU hardware XLA lowers these to ICI all-to-alls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ._attn_wrap import wrap_seq_parallel_attn
+from .collectives import all_to_all
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, s, H, D] local sequence chunk
+    k: jax.Array,  # [B, s, KV, D]
+    v: jax.Array,  # [B, s, KV, D]
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    inner_attn=None,
+):
+    """Seq-sharded -> head-sharded -> full local attention -> back.
+
+    Call inside ``shard_map``. ``inner_attn`` is any ``AttnFn``; default is
+    the plain XLA attention (callers on TPU pass the flash kernel).
+    """
+    if bias is not None:
+        raise NotImplementedError(
+            "ulysses attention does not support bias: a per-head bias "
+            "cannot be resharded through the head all-to-all"
+        )
+    if inner_attn is None:
+        from ..models.layers import default_attention
+
+        inner_attn = default_attention
+    n = jax.lax.psum(1, axis_name)
+    H, KV = q.shape[2], k.shape[2]
+
+    # Head counts must split across the axis; GQA kv heads that cannot are
+    # broadcast up to the query head count first (costs kv bandwidth only).
+    if KV % n:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+
+    # [B, s, H, D] -> [B, S, H/n, D]: split heads, gather sequence.
+    gather = lambda x: all_to_all(x, axis_name, split_dim=2, concat_dim=1)
+    qg, kg, vg = gather(q), gather(k), gather(v)
+    out = inner_attn(qg, kg, vg, causal=causal)
+    # [B, S, H/n, D] -> [B, s, H, D]: split sequence, gather heads.
+    return all_to_all(out, axis_name, split_dim=1, concat_dim=2)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    inner_attn=None,
+):
+    """Build an ``AttnFn`` running Ulysses attention over ``mesh``.
+
+    Global [B, S, H, D] inputs are shard_mapped with batch over the data
+    axes and sequence over ``seq_axis``; heads stay unsharded outside the
+    call (the head split is internal, via all-to-all). Mirrors
+    ``make_ring_attention`` so model families choose per constructor arg.
+    """
+    present = set(mesh.axis_names)
+    if seq_axis not in present:
+        from ..models.layers import default_attention
+
+        return inner_attn or default_attention
+    n = mesh.shape[seq_axis]
+    b = tuple(a for a in batch_axes if a in present) or None
+
+    def validate(q, k, v):
+        if q.shape[2] % n:
+            raise ValueError(
+                f"Ulysses needs the sp axis ({n}) to divide query heads "
+                f"({q.shape[2]})."
+            )
+
+    return wrap_seq_parallel_attn(
+        mesh,
+        name="ulysses attention",
+        spec=P(b, seq_axis, None, None),
+        per_device=lambda q, k, v, causal: ulysses_attention(
+            q, k, v, axis_name=seq_axis, causal=causal, inner_attn=inner_attn
+        ),
+        validate=validate,
+    )
