@@ -1,0 +1,148 @@
+"""FSDP integration tests.
+
+torch FSDP's deferred-init support imports ``torchdistx`` at
+``torch.distributed.fsdp`` import time, so the shim tests run in
+subprocesses where the import order can be controlled. The process group
+is single-rank gloo — the CPU stand-in for a pod, same spirit as the
+virtual CPU mesh for the jax tests.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import torch
+
+from torchdistx_tpu.deferred_init import deferred_init
+from torchdistx_tpu.fake import is_fake
+from torchdistx_tpu.fsdp import make_param_init_fn, make_xla_param_init_fn
+
+
+def _run(script: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.update(
+        MASTER_ADDR="127.0.0.1",
+        MASTER_PORT="29517",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+
+
+def test_param_init_fn_materializes_in_place():
+    m = deferred_init(torch.nn.Linear, 8, 4)
+    assert is_fake(m.weight)
+    make_param_init_fn()(m)
+    assert not is_fake(m.weight)
+    out = m(torch.randn(2, 8))
+    assert torch.isfinite(out).all()
+
+
+def test_xla_param_init_fn_requires_torch_xla():
+    pytest.importorskip("torch", reason="torch required")
+    try:
+        import torch_xla  # noqa: F401
+
+        pytest.skip("torch_xla installed; error path not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="requires torch_xla"):
+        make_xla_param_init_fn()
+
+
+def test_shim_provides_torchdistx_surface():
+    r = _run(
+        """
+import torch
+from torchdistx_tpu.fsdp import install_torchdistx_shim
+install_torchdistx_shim()
+from torchdistx import deferred_init, fake
+with fake.fake_mode():
+    t = torch.ones(10)
+assert fake.is_fake(t)
+m = deferred_init.deferred_init(torch.nn.Linear, 4, 2)
+deferred_init.materialize_module(m)
+assert not fake.is_fake(m.weight)
+print("SHIM-OK")
+"""
+    )
+    assert "SHIM-OK" in r.stdout, r.stderr
+
+
+def test_accelerator_api_survives_import():
+    # Renaming privateuse1 to "tpu" must not break torch.accelerator
+    # consumers (torch FSDP queries _get_accelerator during init).
+    r = _run(
+        """
+import torchdistx_tpu.fake
+import torch
+torch._C._get_accelerator()
+print("ACC-OK")
+"""
+    )
+    assert "ACC-OK" in r.stdout, r.stderr
+
+
+# Note: forward/backward THROUGH torch FSDP cannot run here — this torch
+# build raises "FSDP does not support CPU only execution" at _lazy_init on
+# any model, ours or not. The integration surface (FSDP detecting fakes
+# and materializing them during wrapping) is exactly what these assert.
+
+
+def test_fsdp_with_param_init_fn():
+    r = _run(
+        """
+import torch, torch.distributed as dist
+from torchdistx_tpu.fsdp import install_torchdistx_shim, param_init_fn
+install_torchdistx_shim()  # before FSDP import: enables fake detection
+from torch.distributed.fsdp import FullyShardedDataParallel as FSDP
+from torchdistx_tpu.deferred_init import deferred_init, materialize_module
+from torchdistx_tpu.fake import is_fake
+
+dist.init_process_group("gloo", rank=0, world_size=1)
+build = lambda: torch.nn.Sequential(torch.nn.Linear(16, 16), torch.nn.Linear(16, 4))
+model = deferred_init(build)
+assert is_fake(model[0].weight)
+torch.manual_seed(11)
+wrapped = FSDP(model, param_init_fn=param_init_fn)
+inner = wrapped.module
+assert all(not is_fake(p) for p in inner.parameters())
+# Values match a plain materialization under the same seed.
+ref = deferred_init(build)
+torch.manual_seed(11)
+materialize_module(ref)
+assert torch.equal(inner[0].weight.detach(), ref[0].weight.detach())
+dist.destroy_process_group()
+print("FSDP-OK")
+"""
+    )
+    assert "FSDP-OK" in r.stdout, r.stderr
+
+
+def test_fsdp_builtin_torchdistx_path():
+    # No param_init_fn: FSDP's own torchdistX branch calls our
+    # materialize_module(check_fn=...) — the strongest call-compat check.
+    r = _run(
+        """
+import torch, torch.distributed as dist
+from torchdistx_tpu.fsdp import install_torchdistx_shim
+install_torchdistx_shim()
+from torch.distributed.fsdp import FullyShardedDataParallel as FSDP
+from torchdistx_tpu.deferred_init import deferred_init
+from torchdistx_tpu.fake import is_fake
+
+dist.init_process_group("gloo", rank=0, world_size=1)
+model = deferred_init(
+    lambda: torch.nn.Sequential(torch.nn.Linear(16, 16), torch.nn.Linear(16, 4))
+)
+wrapped = FSDP(model)
+assert all(not is_fake(p) for p in wrapped.module.parameters())
+assert all(torch.isfinite(p).all() for p in wrapped.module.parameters())
+dist.destroy_process_group()
+print("BUILTIN-OK")
+"""
+    )
+    assert "BUILTIN-OK" in r.stdout, r.stderr

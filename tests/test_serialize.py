@@ -148,6 +148,15 @@ class TestErrors:
         with pytest.raises(ValueError, match="no recording"):
             save_recording({"t": t}, tmp_path / "x.tdx")
 
+    def test_loaded_recordings_are_read_only(self, tmp_path):
+        # Extending a loaded graph with new in-place/view ops cannot alias-
+        # track correctly (file-local storage keys), so it must refuse
+        # loudly instead of replaying wrong values.
+        t = deferred_init(lambda: torch.ones(4, 3))
+        loaded = _roundtrip({"t": t}, tmp_path)["t"]
+        with pytest.raises(RuntimeError, match="read-only|loaded recording"):
+            deferred_init(lambda: loaded[2].add_(5))
+
     def test_mutated_external_rejected_at_save(self, tmp_path):
         # Saving must enforce the same version-counter guarantee replay
         # does — not launder an unreplayable recording into a file.

@@ -167,7 +167,7 @@ class OpNode:
 
     __slots__ = (
         "op", "op_nr", "key_nr", "storages", "dependencies", "dependents",
-        "argument_versions", "outputs", "materialized",
+        "argument_versions", "outputs", "materialized", "loaded",
         "_ng", "_nid", "__weakref__",
     )
 
@@ -175,6 +175,10 @@ class OpNode:
         self.op = op
         self.op_nr = _next_op_nr()
         self.key_nr = _next_key_nr(self.op_nr)
+        # True for nodes rebuilt by serialize.load_recording: their storage
+        # alias keys are file-local, so the graph cannot be *extended* with
+        # new in-place/view ops (record_op rejects it); replay is unaffected.
+        self.loaded = False
         # Meta storages of fake outputs: the alias/in-place detection key
         # (deferred_init.cc:384, 413-425).
         self.storages: Set[int] = set()
@@ -375,6 +379,13 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
                 "outside of deferred-init cannot be used inside a "
                 "deferred-init context (see the reference's identical "
                 "constraint, deferred_init.cc:821-832)."
+            )
+        if ctx.node.loaded:
+            raise RuntimeError(
+                "A fake tensor from a loaded recording cannot be used in "
+                "new deferred-init ops: its alias-tracking keys are "
+                "file-local, so extensions would replay incorrectly. "
+                "Record additional ops before save_recording instead."
             )
         idx = len(dependencies)
         seen_fakes[id(fake)] = idx

@@ -96,8 +96,37 @@ class _TpuDeviceStub:
 try:  # pragma: no cover - depends on torch build
     torch.utils.rename_privateuse1_backend("tpu")
     torch._register_device_module("tpu", _TpuDeviceStub)
+    _tpu_renamed = True
 except RuntimeError:
-    pass
+    _tpu_renamed = False
+
+if _tpu_renamed:
+    # Renaming privateuse1 makes torch.accelerator consider the backend
+    # registered, and torch._C._get_accelerator() then *throws* unless
+    # accelerator hooks exist — breaking unrelated consumers (torch FSDP
+    # queries it during init). Register the stock Python dummy hooks so
+    # accelerator APIs keep working; the stub still reports unavailable.
+    # Kept separate from the rename: a hook-API failure must be surfaced,
+    # not masked, since the rename alone leaves torch.accelerator broken.
+    try:  # pragma: no cover - depends on torch build
+        import torch.utils.backend_registration as _br
+
+        torch._C._acc.register_python_privateuseone_hook(
+            _br._DummyPrivateUse1Hook()
+        )
+        torch._C._acc.register_python_privateuseone_device_guard(
+            _br._DummyDeviceGuard()
+        )
+    except (AttributeError, ImportError):
+        import warnings
+
+        warnings.warn(
+            "torchdistx_tpu renamed the privateuse1 backend to 'tpu' but "
+            "could not register accelerator hooks on this torch build; "
+            "torch.accelerator APIs (used by torch FSDP) may raise until "
+            "hooks are registered.",
+            RuntimeWarning,
+        )
 
 
 def _attr_name_of_meta_owner() -> str:

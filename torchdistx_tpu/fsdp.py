@@ -1,0 +1,122 @@
+"""FSDP integration: sharded initialization the way the reference is used.
+
+The reference's entire purpose is to feed FSDP-style libraries
+(docs/src/deferred_init.rst:17-44): construct the model fake, let the
+wrapper decide sharding, then materialize per wrapped unit. torch's FSDP
+ships native torchdistX support — ``torch.distributed.fsdp._init_utils``
+detects fake parameters via ``torchdistx.fake.is_fake`` and materializes
+units through ``torchdistx.deferred_init.materialize_module(…,
+check_fn=…)``. This module makes that machinery work against this
+framework:
+
+* :func:`install_torchdistx_shim` — register this package under the
+  ``torchdistx`` module name (``torchdistx.fake`` /
+  ``torchdistx.deferred_init``), the drop-in switch for every consumer of
+  the reference, torch FSDP included. Call it **before** importing
+  ``torch.distributed.fsdp`` (FSDP snapshots availability at import).
+* :func:`param_init_fn` / :func:`make_param_init_fn` — the explicit
+  ``FSDP(…, param_init_fn=…)`` route; FSDP calls it once per module to
+  materialize, shared/tied fakes materialize once.
+
+For torch-xla's FSDP (``torch_xla.distributed.fsdp``), the same
+``param_init_fn`` object is accepted; torch_xla is optional and only
+touched inside :func:`make_xla_param_init_fn`.
+
+For the jax-native path (materialize straight into sharded HBM with no
+torch distributed runtime at all) see
+:func:`torchdistx_tpu.jax_bridge.materialize_module_jax` — that is the
+recommended route on TPU pods; this module exists for torch-ecosystem
+compatibility.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import sys
+import types
+from typing import Callable, Optional
+
+import torch
+
+from . import deferred_init as _deferred_init_mod
+from . import fake as _fake_mod
+from .deferred_init import materialize_module
+from ._graph import ReplayTarget
+
+__all__ = [
+    "install_torchdistx_shim",
+    "param_init_fn",
+    "make_param_init_fn",
+    "make_xla_param_init_fn",
+]
+
+
+def install_torchdistx_shim(*, force: bool = False) -> None:
+    """Expose this framework as importable ``torchdistx``.
+
+    After this, ``from torchdistx import deferred_init, fake`` resolves to
+    this package's call-compatible modules — which is exactly the import
+    torch FSDP's deferred-init support performs. No-op if a real
+    ``torchdistx`` is already importable (unless ``force``).
+    """
+    if not force:
+        try:
+            if importlib.util.find_spec("torchdistx") is not None:
+                return  # a real torchdistx is importable; don't shadow it
+        except (ImportError, ValueError):
+            pass
+    shim = types.ModuleType("torchdistx")
+    shim.__doc__ = "torchdistx compatibility shim provided by torchdistx_tpu."
+    # A real spec: import machinery (importlib.util.find_spec, used e.g. by
+    # transformers' lazy imports) rejects modules whose __spec__ is None.
+    shim.__spec__ = importlib.machinery.ModuleSpec("torchdistx", loader=None)
+    shim.__path__ = []  # mark as package so find_spec of submodules works
+    shim.fake = _fake_mod
+    shim.deferred_init = _deferred_init_mod
+    sys.modules["torchdistx"] = shim
+    sys.modules["torchdistx.fake"] = _fake_mod
+    sys.modules["torchdistx.deferred_init"] = _deferred_init_mod
+
+
+def make_param_init_fn(
+    *,
+    check_fn: Optional[Callable[[torch.nn.Module], bool]] = None,
+    target: Optional[ReplayTarget] = None,
+) -> Callable[[torch.nn.Module], None]:
+    """Build a ``param_init_fn`` for ``FSDP(…, param_init_fn=…)``.
+
+    FSDP invokes it per module-to-materialize; fakes already swapped by an
+    earlier call are skipped, so nested wrapping cannot double-replay.
+    ``target`` retargets replay (e.g. a different device); ``check_fn``
+    gates submodules exactly like :func:`materialize_module`.
+    """
+
+    def _init(module: torch.nn.Module) -> None:
+        materialize_module(module, check_fn=check_fn, target=target)
+
+    return _init
+
+
+# The common case, usable directly as FSDP(…, param_init_fn=param_init_fn).
+param_init_fn = make_param_init_fn()
+
+
+def make_xla_param_init_fn(device: Optional[str] = None):
+    """``param_init_fn`` replaying straight onto a torch-xla device.
+
+    Requires torch_xla (optional dependency); raises a clear error when it
+    is absent. On TPU pods prefer the jax bridge
+    (``materialize_module_jax``), which shards during materialization
+    instead of replicating then sharding.
+    """
+    try:
+        import torch_xla.core.xla_model as xm
+    except ImportError as e:  # pragma: no cover - torch_xla not in CI image
+        raise RuntimeError(
+            "make_xla_param_init_fn requires torch_xla, which is not "
+            "installed. Use torchdistx_tpu.jax_bridge.materialize_module_jax "
+            "for the jax-native sharded path."
+        ) from e
+    dev = torch.device(device) if device is not None else xm.xla_device()
+    return make_param_init_fn(target=ReplayTarget(device=dev))

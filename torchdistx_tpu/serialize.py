@@ -278,6 +278,7 @@ def load_recording(path) -> Dict[str, FakeTensor]:
         )
         node = OpNode(op)
         node.key_nr = rec["key_nr"]
+        node.loaded = True  # read-only graph: record_op refuses extensions
         node.storages = set(rec["storages"])
         node.dependencies = [(nodes[i], out) for i, out in rec["deps"]]
         for dep, _ in node.dependencies:
