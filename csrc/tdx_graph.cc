@@ -52,28 +52,28 @@ bool storages_intersect(const Node& a, const Node& b) {
   return false;
 }
 
-// Transitive dependents of `root` (excluding root).
-std::vector<Node*> transitive_dependents(Graph& g, Node& root) {
-  std::vector<Node*> out;
-  std::unordered_set<uint64_t> seen{root.id};
-  std::vector<uint64_t> stack(root.dependents.begin(), root.dependents.end());
-  while (!stack.empty()) {
-    uint64_t id = stack.back();
-    stack.pop_back();
-    if (seen.count(id)) continue;
-    seen.insert(id);
-    Node* n = g.get(id);
-    if (!n) continue;
-    out.push_back(n);
-    for (uint64_t d : n->dependents) stack.push_back(d);
-  }
-  return out;
-}
-
+// Latest node mutating `node`'s storages: walks BOTH dependent and
+// dependency edges through storage-aliasing nodes. (The reference walks
+// dependents only, deferred_init.cc:537-575, which misses in-place ops
+// recorded against a view's base fake; see _graph.py last_in_place_node.)
 Node* last_in_place(Graph& g, Node& node) {
   Node* last = &node;
-  for (Node* n : transitive_dependents(g, node)) {
-    if (n->op_nr > last->op_nr && storages_intersect(*n, node)) last = n;
+  std::unordered_set<uint64_t> seen{node.id};
+  std::vector<uint64_t> stack{node.id};
+  while (!stack.empty()) {
+    Node* n = g.get(stack.back());
+    stack.pop_back();
+    if (!n) continue;
+    auto consider = [&](uint64_t mid) {
+      if (seen.count(mid)) return;
+      seen.insert(mid);
+      Node* m = g.get(mid);
+      if (!m || !storages_intersect(*m, node)) return;
+      if (m->op_nr > last->op_nr) last = m;
+      stack.push_back(mid);
+    };
+    for (uint64_t d : n->dependents) consider(d);
+    for (auto& [dep_id, idx] : n->deps) consider(dep_id);
   }
   return last;
 }

@@ -54,9 +54,14 @@ int main() {
   auto s = stack_of(g, n5);
   assert((s == std::vector<uint64_t>{n1, n2, n3, n4, n5}));
 
-  // materialize(v) at n4: later mutation n5 is excluded (op_nr > last).
+  // materialize(v) at n4: the later mutation n5 of the shared storage is
+  // INCLUDED — eager semantics (v is a view of w; mul_(w) changes v). The
+  // bidirectional last-in-place walk reaches n5 via the dependency edge
+  // n4 -> n3 -> n2 -> dependent n5 (the reference's dependents-only walk
+  // missed it and replayed the stale value).
+  assert(tdx_last_in_place(g, n4) == n5);
   auto sv = stack_of(g, n4);
-  assert((sv == std::vector<uint64_t>{n1, n2, n3, n4}));
+  assert((sv == std::vector<uint64_t>{n1, n2, n3, n4, n5}));
 
   // last-in-place from the producer n2 must find n5.
   assert(tdx_last_in_place(g, n2) == n5);

@@ -128,6 +128,21 @@ class TestInPlaceAndViews:
         assert rw[0, 0].item() == 12.0  # (1+5)*2
         assert rw[1, 1].item() == 2.0
 
+    def test_view_sees_later_base_mutation(self):
+        # Materializing only the VIEW must replay the later in-place op on
+        # its base (eager semantics; found by the replay fuzzer). The
+        # mutation node depends on the base's producer, not the view node,
+        # so the dependents-only walk of the reference missed it.
+        def make():
+            w = torch.full((4, 3), -3.0)
+            v = w[2]
+            w.mul_(-1.0)
+            return v
+
+        v = deferred_init(make)
+        rv = materialize_tensor(v)
+        assert torch.equal(rv, torch.full((3,), 3.0))
+
     def test_view_materialization(self):
         def make():
             w = torch.empty(4, 4)

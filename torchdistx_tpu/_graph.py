@@ -235,26 +235,31 @@ class OpNode:
 
     # -- graph walks -----------------------------------------------------
 
-    def transitive_dependents(self) -> List["OpNode"]:
-        seen: Set[int] = {id(self)}
-        out: List[OpNode] = []
-        stack = list(self.dependents)
+    def last_in_place_node(self) -> "OpNode":
+        """Latest node mutating this node's storages.
+
+        Walks BOTH dependent and dependency edges, traversing through
+        storage-aliasing nodes. The reference walks dependents only
+        (getLastInPlaceOpNode, deferred_init.cc:537-575), which misses
+        in-place ops recorded against a view's *base* fake — the mutation
+        node depends on the base's producer, not on the view node — and
+        replays the stale pre-mutation value. The bidirectional walk
+        reaches every alias-relative, restoring eager semantics (found by
+        the replay fuzzer, tests/test_fuzz_replay.py)."""
+        last = self
+        seen = {id(self)}
+        stack: List[OpNode] = [self]
         while stack:
             n = stack.pop()
-            if id(n) in seen:
-                continue
-            seen.add(id(n))
-            out.append(n)
-            stack.extend(n.dependents)
-        return out
-
-    def last_in_place_node(self) -> "OpNode":
-        """getLastInPlaceOpNode (deferred_init.cc:537-575): the latest
-        dependent whose outputs alias this node's storages."""
-        last = self
-        for n in self.transitive_dependents():
-            if n.storages & self.storages and n.op_nr > last.op_nr:
-                last = n
+            for m in list(n.dependents) + [d for d, _ in n.dependencies]:
+                if id(m) in seen:
+                    continue
+                seen.add(id(m))
+                if not (m.storages & self.storages):
+                    continue
+                if m.op_nr > last.op_nr:
+                    last = m
+                stack.append(m)
         return last
 
     def build_call_stack(self) -> List["OpNode"]:
