@@ -12,13 +12,23 @@ with a warning (parallel/sharding.py).
 
 from __future__ import annotations
 
+from typing import Optional
+
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import ShardingPlan
 
 
-def decoder_lm_plan(*, fsdp: str = "fsdp", tp: str = "tp", ep: str = "ep") -> ShardingPlan:
-    """Plan for LlamaModel / GPT2Model / Mixtral param trees."""
+def decoder_lm_plan(
+    *,
+    fsdp: Optional[str] = "fsdp",
+    tp: Optional[str] = "tp",
+    ep: Optional[str] = "ep",
+) -> ShardingPlan:
+    """Plan for LlamaModel / GPT2Model / Mixtral param trees.
+
+    Pass ``tp=None`` (etc.) to drop an axis entirely when building a plan
+    for a mesh that intentionally lacks it — no absent-axis warnings."""
     return ShardingPlan(
         [
             # attention projections [L, d, H, hd] / [L, H, hd, d]
@@ -44,7 +54,7 @@ def decoder_lm_plan(*, fsdp: str = "fsdp", tp: str = "tp", ep: str = "ep") -> Sh
     )
 
 
-def t5_plan(*, fsdp: str = "fsdp", tp: str = "tp") -> ShardingPlan:
+def t5_plan(*, fsdp: Optional[str] = "fsdp", tp: Optional[str] = "tp") -> ShardingPlan:
     """2D plan for T5Model param trees (BASELINE "GSPMD 2D shard")."""
     return ShardingPlan(
         [
