@@ -28,6 +28,38 @@ __all__ = [
     "materialize_module_jax",
 ]
 
+# Init programs execute once for milliseconds; optimized codegen buys
+# nothing while costing ~2x compile wall time on TPU.  Ask XLA for its
+# lowest effort.  Whether the active backend accepts the option is probed
+# ONCE on a trivial program, so real compile failures on init programs
+# propagate immediately instead of being retried at full effort.
+_INIT_COMPILER_OPTIONS = {"exec_time_optimization_effort": -1.0}
+_options_supported: Optional[bool] = None
+
+
+def _compiler_options() -> Optional[dict]:
+    global _options_supported
+    if _options_supported is None:
+        try:
+            jax.jit(lambda: jax.numpy.zeros(())).lower().compile(
+                compiler_options=_INIT_COMPILER_OPTIONS
+            )
+            _options_supported = True
+        except Exception:
+            _options_supported = False
+    return _INIT_COMPILER_OPTIONS if _options_supported else None
+
+
+def _run_init(init_fn, key, out_shardings=None):
+    if out_shardings is not None:
+        jitted = jax.jit(init_fn, out_shardings=out_shardings)
+    else:
+        jitted = jax.jit(init_fn)
+    opts = _compiler_options()
+    if opts is None:
+        return jitted(key)
+    return jitted.lower(key).compile(compiler_options=opts)(key)
+
 
 def named_fake_tensors(module: torch.nn.Module) -> Dict[str, torch.Tensor]:
     """All fake parameters and buffers of ``module`` by qualified name,
@@ -67,18 +99,16 @@ def materialize_params_jax(
     """
     names = list(fakes.keys())
     fake_list = [fakes[n] for n in names]
-    init_fn = build_init_fn(fake_list, seed=seed)
+    init_fn = build_init_fn(fake_list)
 
+    out_shardings = None
     if mesh is not None:
         plan = plan or ShardingPlan()
         out_shardings = tuple(
             NamedSharding(mesh, plan.spec_for(n, tuple(f.shape), mesh))
             for n, f in zip(names, fake_list)
         )
-        fn = jax.jit(init_fn, out_shardings=out_shardings)
-    else:
-        fn = jax.jit(init_fn)
-    values = fn()
+    values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
     return dict(zip(names, values))
 
 
@@ -92,13 +122,11 @@ def materialize_tensor_jax(
     """Materialize one fake tensor as a (sharded) jax.Array."""
     if not is_fake(tensor):
         raise ValueError("`tensor` is not fake; nothing to materialize.")
-    init_fn = build_init_fn([tensor], seed=seed)
+    init_fn = build_init_fn([tensor])
+    out_shardings = None
     if mesh is not None:
-        sharding = NamedSharding(mesh, spec or PartitionSpec())
-        fn = jax.jit(init_fn, out_shardings=(sharding,))
-    else:
-        fn = jax.jit(init_fn)
-    return fn()[0]
+        out_shardings = (NamedSharding(mesh, spec or PartitionSpec()),)
+    return _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)[0]
 
 
 def materialize_module_jax(
