@@ -171,10 +171,14 @@ class OpNode:
         "_ng", "_nid", "__weakref__",
     )
 
-    def __init__(self, op: Op):
+    def __init__(self, op: Op, *, key_nr: Optional[int] = None):
         self.op = op
         self.op_nr = _next_op_nr()
-        self.key_nr = _next_key_nr(self.op_nr)
+        # An explicit key_nr (serialize.load_recording rebuilding saved
+        # nodes) must NOT consume the thread-local session counter, or
+        # loading a recording mid-session would shift the RNG keys of
+        # every subsequently recorded op (ADVICE r1).
+        self.key_nr = _next_key_nr(self.op_nr) if key_nr is None else key_nr
         # True for nodes rebuilt by serialize.load_recording: their storage
         # alias keys are file-local, so the graph cannot be *extended* with
         # new in-place/view ops (record_op rejects it); replay is unaffected.
@@ -445,6 +449,45 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
         tensor_idx += 1
 
     node._native_sync_edges()
+
+
+# ---------------------------------------------------------------------------
+# Synthetic ops — recorded calls that are not ATen OpOverloads.  The
+# registry gives them a stable name for serialization (serialize.py) and
+# the jax bridge's lowering table.
+# ---------------------------------------------------------------------------
+
+
+def _set_data_replay(base: torch.Tensor, value: torch.Tensor) -> torch.Tensor:
+    # Replays `base.data = value` on real tensors (reference replay
+    # closure for "VariableHooks::set_data", deferred_init.cc:949-971).
+    base.data = value
+    return base
+
+
+SYNTHETIC_OPS: Dict[str, Any] = {"tdx::set_data": _set_data_replay}
+
+
+def _record_set_data(fake: FakeTensor, new: torch.Tensor) -> None:
+    """Record `fake.data = new` into the replay graph.
+
+    Called by fake._set_data AFTER the meta swap, so the node's storage
+    key is the new (shared) storage and later ops alias correctly.  Fakes
+    with no deferred-init context (plain fake_mode) record nothing — the
+    reference likewise only proxies set_data while deferred-init is
+    enabled (deferred_init.cc:1073-1096).
+    """
+    has_ctx = get_fake_context(fake, CONTEXT_KEY) is not None or (
+        is_fake(new) and get_fake_context(new, CONTEXT_KEY) is not None
+    )
+    if not has_ctx:
+        return
+    record_op(_set_data_replay, (fake, new), {}, fake, name="tdx::set_data")
+
+
+from . import fake as _fake_module  # noqa: E402  (install the hook)
+
+_fake_module._set_data_recorder = _record_set_data
 
 
 # ---------------------------------------------------------------------------

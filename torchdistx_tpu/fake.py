@@ -193,10 +193,59 @@ class FakeTensor(torch.Tensor):
         # tensor's key set, not only in TLS (fake.cc:186-205).
         return _fake_handler(func, args, kwargs or {})
 
+    # -- .data interception ----------------------------------------------
+    # ``Tensor.data`` reads/writes bypass the dispatcher (they are C-level
+    # variable_data/set_data calls), which is why the reference swaps in a
+    # recording VariableHooks proxy (deferred_init.cc:908-1135).  A wrapper
+    # subclass has a cheaper route: a Python property shadows the C getset
+    # for fake tensors only, rerouting reads through a normal recorded
+    # detach and writes through :func:`_set_data`.
+
+    @property
+    def data(self):
+        return self.detach()
+
+    @data.setter
+    def data(self, new):
+        _set_data(self, new)
+
 
 def is_fake(tensor: torch.Tensor) -> bool:
     """``True`` if ``tensor`` is fake (reference fake.py:53-55, fake.cc:621-627)."""
     return isinstance(tensor, FakeTensor)
+
+
+# Installed by _graph at import time: records `fake.data = x` as a
+# synthetic replay op when the fake participates in a deferred-init
+# recording (reference records "VariableHooks::set_data",
+# deferred_init.cc:930-971).  The swap itself happens here either way.
+_set_data_recorder: Optional[Any] = None
+
+
+def _set_data(fake: FakeTensor, new: torch.Tensor) -> None:
+    """``fake.data = new``: rebind the fake's meta to (a storage-sharing
+    view of) ``new``'s metadata, preserving the wrapper object.
+
+    torch's set_data allows shape/dtype changes; a wrapper subclass's
+    metadata is fixed at construction, so those raise with remediation
+    (same restriction class as `_refresh_fake`'s shape-changing path).
+    """
+    if is_fake(new):
+        new_meta = new._meta.detach()  # shares storage: p.data = w aliases w
+    else:
+        new_meta = torch.empty_like(new, device="meta")
+    if new_meta.shape != fake._meta.shape or new_meta.dtype != fake._meta.dtype:
+        raise NotImplementedError(
+            f"shape- or dtype-changing `.data` assignment on a fake tensor "
+            f"is not supported (old {tuple(fake._meta.shape)}/"
+            f"{fake._meta.dtype}, new {tuple(new_meta.shape)}/"
+            f"{new_meta.dtype}). Assign a tensor of matching metadata, or "
+            f"construct the module with the target shape."
+        )
+    fake._meta = new_meta
+    setattr(new_meta, _attr_name_of_meta_owner(), weakref.ref(fake))
+    if _set_data_recorder is not None:
+        _set_data_recorder(fake, new)
 
 
 def meta_tensor(tensor: torch.Tensor) -> torch.Tensor:

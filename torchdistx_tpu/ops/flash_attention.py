@@ -78,7 +78,7 @@ def _fwd_kernel(
     k_ref,  # [1, block_k, D]
     v_ref,  # [1, block_k, D]
     o_ref,  # [1, block_q, D]
-    lse_ref,  # [1, block_q, 1]
+    lse_ref,  # [1, block_q] (2D: minor dim is the full block, lane-aligned)
     acc_ref,  # VMEM [block_q, D] f32
     m_ref,  # VMEM [block_q, _LANES] f32
     l_ref,  # VMEM [block_q, _LANES] f32
@@ -137,7 +137,8 @@ def _fwd_kernel(
     def _finish():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse_ref[0] = lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -193,10 +194,10 @@ def _bwd_dq_kernel(
         _, ds = _block_p_ds(
             q_ref[0].astype(jnp.float32),
             k_ref[0].astype(jnp.float32),
-            lse_ref[0, :, 0],
+            lse_ref[0],
             do_ref[0].astype(jnp.float32),
             v_ref[0].astype(jnp.float32),
-            delta_ref[0, :, 0],
+            delta_ref[0],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
         )
@@ -248,10 +249,10 @@ def _bwd_dkv_kernel(
         p, ds = _block_p_ds(
             q,
             k_ref[0].astype(jnp.float32),
-            lse_ref[0, :, 0],
+            lse_ref[0],
             do,
             v_ref[0].astype(jnp.float32),
-            delta_ref[0, :, 0],
+            delta_ref[0],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
         )
@@ -308,11 +309,14 @@ def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+            # 2D lse with the block as the minor dim: a (1, bq, 1) block
+            # has a 1-wide minor dim, which TPU lowering pads/lays out
+            # degenerately (ADVICE r1); (1, bq) is lane-aligned.
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(qp.shape, qh.dtype),
-            jax.ShapeDtypeStruct((BH, qp.shape[1], 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, qp.shape[1]), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -333,7 +337,7 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qp, dop = _pad_seq(qh, block_q), _pad_seq(do, block_q)
     kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
-    dp, lsep = _pad_seq(delta[..., None], block_q), lse  # lse padded by fwd
+    dp, lsep = _pad_seq(delta, block_q), lse  # [BH, Sq] 2D; lse padded by fwd
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
     common = dict(
@@ -341,7 +345,7 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
         block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
     )
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0))
-    rowspec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    rowspec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -367,7 +371,7 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
         (1, block_q, D), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
     )
     rowspec2 = pl.BlockSpec(
-        (1, block_q, 1), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
+        (1, block_q), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq)
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, groups=groups, **common),

@@ -25,6 +25,7 @@ Collectives are ``lax.all_to_all`` over a named mesh axis inside
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -63,8 +64,17 @@ def ulysses_attention(
     H, KV = q.shape[2], k.shape[2]
 
     # Head counts must split across the axis; GQA kv heads that cannot are
-    # broadcast up to the query head count first (costs kv bandwidth only).
+    # broadcast up to the query head count first.  That multiplies the K/V
+    # all-to-all volume by H/KV (e.g. 8x for KV=4, H=32) — exactly the
+    # regime where ring attention keeps the GQA bandwidth advantage — so
+    # the degradation is surfaced rather than silent (ADVICE r1).
     if KV % n:
+        warnings.warn(
+            f"ulysses: {KV} KV heads do not divide the sequence axis size "
+            f"{n}; broadcasting K/V to {H} query heads multiplies K/V "
+            f"all-to-all volume {H // KV}x. Consider ring attention for "
+            f"small-KV models (parallel/ring_attention.py)."
+        )
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)
 

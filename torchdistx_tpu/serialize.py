@@ -276,8 +276,7 @@ def load_recording(path) -> Dict[str, FakeTensor]:
             grad_enabled=rec["grad_enabled"],
             name=rec["name"],
         )
-        node = OpNode(op)
-        node.key_nr = rec["key_nr"]
+        node = OpNode(op, key_nr=rec["key_nr"])
         node.loaded = True  # read-only graph: record_op refuses extensions
         node.storages = set(rec["storages"])
         node.dependencies = [(nodes[i], out) for i, out in rec["deps"]]
