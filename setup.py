@@ -47,7 +47,7 @@ setup(
         "record init, materialize sharded into TPU HBM via XLA"
     ),
     packages=find_packages(include=["torchdistx_tpu", "torchdistx_tpu.*"]),
-    package_data={"torchdistx_tpu": ["_lib/*.so"]},
+    package_data={"torchdistx_tpu": ["_lib/*.so", "py.typed"]},
     python_requires=">=3.10",
     install_requires=[
         "jax>=0.4.30",

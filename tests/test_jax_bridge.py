@@ -235,3 +235,45 @@ class TestTransformerEndToEnd:
         # values finite and initialized
         w = np.asarray(p["transformer.h.0.attn.c_attn.weight"])
         assert np.isfinite(w).all() and w.std() > 0
+
+
+class TestSyntheticOps:
+    def test_set_data_lowering(self):
+        # `p.data = w` lowers as a value rebind of the base's box.
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4, bias=False)
+                self.lin.weight.data = torch.full((4, 4), 1.5)
+
+        m = deferred_init(M)
+        p = materialize_module_jax(m, seed=0)
+        assert np.allclose(np.asarray(p["lin.weight"]), 1.5)
+
+    def test_data_inplace_normal_lowering(self):
+        # The HF `_init_weights` idiom through the .data detach view.
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(16, 16, bias=False)
+                self.lin.weight.data.normal_(0.0, 0.02)
+
+        m = deferred_init(M)
+        p = materialize_module_jax(m, seed=0)
+        w = np.asarray(p["lin.weight"])
+        assert np.isfinite(w).all()
+        assert 0.005 < w.std() < 0.05
+
+    def test_default_dtype_tls_lowering(self):
+        # Factories recorded under torch.set_default_dtype(bfloat16)
+        # resolve their dtype from the captured per-op TLS.
+        def make():
+            torch.set_default_dtype(torch.bfloat16)
+            try:
+                return torch.ones(4)
+            finally:
+                torch.set_default_dtype(torch.float32)
+
+        t = deferred_init(make)
+        arr = materialize_tensor_jax(t)
+        assert arr.dtype == jnp.bfloat16

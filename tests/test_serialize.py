@@ -206,3 +206,44 @@ class TestSessionIsolation:
         got = materialize_params_jax({"a": got_a, "b": got_b}, seed=3)
         assert np.array_equal(np.asarray(ref["a"]), np.asarray(got["a"]))
         assert np.array_equal(np.asarray(ref["b"]), np.asarray(got["b"]))
+
+
+class TestTlsRoundTrip:
+    def test_autocast_tls_roundtrips(self, tmp_path):
+        """A recording made under torch.autocast must replay identically
+        after save/load (Op.tls is part of the v2 format)."""
+        import torch.nn as nn
+        from torchdistx_tpu.deferred_init import materialize_tensor
+
+        def make():
+            with torch.autocast("cpu"):
+                return torch.mm(torch.ones(4, 4), torch.ones(4, 4))
+
+        t = deferred_init(make)
+        assert t.dtype == torch.bfloat16
+        p = tmp_path / "ac.tdx"
+        save_recording({"t": t}, p)
+        loaded = load_recording(p)
+        out = materialize_tensor(loaded["t"])
+        assert out.dtype == torch.bfloat16
+        assert torch.equal(out, torch.full((4, 4), 4.0, dtype=torch.bfloat16))
+
+    def test_set_data_synthetic_op_roundtrips(self, tmp_path):
+        import torch.nn as nn
+        from torchdistx_tpu.deferred_init import materialize_module
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(3, 3, bias=False)
+                self.lin.weight.data = torch.full((3, 3), 1.25)
+
+        m = deferred_init(M)
+        p = tmp_path / "sd.tdx"
+        save_recording(m, p)
+        loaded = load_recording(p)
+        from torchdistx_tpu.deferred_init import materialize_tensor
+
+        name = next(iter(loaded))
+        w = materialize_tensor(loaded[name])
+        assert torch.equal(w, torch.full((3, 3), 1.25))

@@ -1,0 +1,74 @@
+"""Microbenchmark: native C++ graph walks (csrc/tdx_graph.cc) vs the
+pure-Python reference implementation.
+
+Records a 70B-shaped init graph — N "layers", each an `empty → normal_ →
+view → mul_ → add_` chain plus a shared-storage mutation so the alias
+walks have real work — then times `build_call_stack` from every layer's
+final fake (the walk `materialize_module` does per parameter).
+
+Run (from the repo root, after `make native`):
+
+    TDX_NATIVE=1 python tools/bench_native.py
+    TDX_NATIVE=0 python tools/bench_native.py
+
+Prints one JSON line; the comparison lives in docs/design.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import torch
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from torchdistx_tpu import _native  # noqa: E402
+from torchdistx_tpu._graph import CONTEXT_KEY, get_fake_context  # noqa: E402
+from torchdistx_tpu.deferred_init import deferred_init  # noqa: E402
+
+
+def record(n_layers: int = 80, ops_per_layer: int = 12):
+    def make():
+        outs = []
+        for _ in range(n_layers):
+            w = torch.empty(64, 64)
+            w.normal_()
+            v = w.view(4096)
+            for _ in range((ops_per_layer - 3) // 2):
+                v.mul_(1.01)
+                w.add_(0.001)
+            outs.append(w)
+        return outs
+
+    return deferred_init(make)
+
+
+def main() -> None:
+    fakes = record()
+    nodes = [get_fake_context(f, CONTEXT_KEY).node for f in fakes]
+    n_nodes = max(n.op_nr for n in nodes) + 1
+
+    t0 = time.perf_counter()
+    total = 0
+    for n in nodes:
+        total += len(n.build_call_stack())
+    dt = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "native": _native.available(),
+                "layers": len(nodes),
+                "graph_nodes": n_nodes,
+                "walk_s": round(dt, 4),
+                "stacks_total": total,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

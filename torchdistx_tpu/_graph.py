@@ -30,6 +30,7 @@ compile the same graph into an XLA program with sharded outputs.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import weakref
 from dataclasses import dataclass
@@ -141,6 +142,81 @@ def _storage_key(meta: torch.Tensor) -> int:
     return meta.untyped_storage()._cdata
 
 
+class ThreadLocalState:
+    """Replay-relevant thread-local state, captured per recorded op and
+    restored around its replay — the counterpart of the reference's
+    ``at::ThreadLocalState`` capture in ``Op``'s constructor and its
+    ``ThreadLocalStateGuard`` during materialization
+    (deferred_init.cc:207, 263).
+
+    Captures grad mode, per-device autocast (enabled + dtype for every
+    autocast-capable backend the build knows), the autocast cache flag,
+    and the default dtype (factory ops recorded without an explicit
+    ``dtype=`` resolve it at replay time).
+    """
+
+    __slots__ = ("grad_enabled", "autocast", "autocast_cache_enabled",
+                 "default_dtype")
+
+    _DEVICES = ("cpu", "cuda")
+    # Device-typed autocast introspection landed in torch 2.4; on older
+    # torch the capture degrades to grad mode + default dtype only.
+    _HAS_DEVICE_AUTOCAST = hasattr(torch, "get_autocast_dtype")
+
+    def __init__(self, grad_enabled: bool, autocast: tuple,
+                 autocast_cache_enabled: bool, default_dtype: torch.dtype):
+        self.grad_enabled = grad_enabled
+        # ((device_type, enabled, dtype), ...)
+        self.autocast = autocast
+        self.autocast_cache_enabled = autocast_cache_enabled
+        self.default_dtype = default_dtype
+
+    @classmethod
+    def capture(cls) -> "ThreadLocalState":
+        if cls._HAS_DEVICE_AUTOCAST:
+            autocast = tuple(
+                (d, torch.is_autocast_enabled(d), torch.get_autocast_dtype(d))
+                for d in cls._DEVICES
+            )
+            cache = torch.is_autocast_cache_enabled()
+        else:  # torch < 2.4
+            autocast, cache = (), True
+        return cls(
+            grad_enabled=torch.is_grad_enabled(),
+            autocast=autocast,
+            autocast_cache_enabled=cache,
+            default_dtype=torch.get_default_dtype(),
+        )
+
+    def restore(self):
+        """Context manager restoring the captured state on this thread.
+
+        Hot path (`materialize_module` replays thousands of ops): contexts
+        are entered only for state that actually differs from ambient."""
+        stack = contextlib.ExitStack()
+        stack.enter_context(torch.set_grad_enabled(self.grad_enabled))
+        prev_default = torch.get_default_dtype()
+        if prev_default != self.default_dtype:
+            torch.set_default_dtype(self.default_dtype)
+            stack.callback(torch.set_default_dtype, prev_default)
+        for device_type, enabled, dtype in self.autocast:
+            if torch.is_autocast_enabled(device_type) != enabled or (
+                enabled and torch.get_autocast_dtype(device_type) != dtype
+            ):
+                stack.enter_context(
+                    torch.autocast(
+                        device_type, dtype=dtype, enabled=enabled,
+                        cache_enabled=self.autocast_cache_enabled,
+                    )
+                )
+        return stack
+
+    def __eq__(self, other):
+        return isinstance(other, ThreadLocalState) and all(
+            getattr(self, s) == getattr(other, s) for s in self.__slots__
+        )
+
+
 @dataclass
 class Op:
     """One recorded call (deferred_init.cc:163-297)."""
@@ -148,11 +224,15 @@ class Op:
     func: Any  # OpOverload or callable with torch-like signature
     args: tuple
     kwargs: dict
-    grad_enabled: bool
+    tls: ThreadLocalState
     name: str
 
+    @property
+    def grad_enabled(self) -> bool:
+        return self.tls.grad_enabled
+
     def replay(self, target: "ReplayTarget", resolved_args, resolved_kwargs):
-        with torch.set_grad_enabled(self.grad_enabled):
+        with self.tls.restore():
             return target.run(self, resolved_args, resolved_kwargs)
 
 
@@ -409,7 +489,7 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
         func=func,
         args=preserved_args,
         kwargs=preserved_kwargs,
-        grad_enabled=torch.is_grad_enabled(),
+        tls=ThreadLocalState.capture(),
         name=name or str(func),
     )
     node = OpNode(op)
@@ -483,6 +563,15 @@ def _record_set_data(fake: FakeTensor, new: torch.Tensor) -> None:
     if not has_ctx:
         return
     record_op(_set_data_replay, (fake, new), {}, fake, name="tdx::set_data")
+    # Alias keep-alive, mirrored: after `p.data = w`, later mutations of
+    # the shared storage recorded *through w* live on nodes held only by
+    # w's context — which dies with w. Retain w's context on p's (the
+    # same lifetime protocol as record_op's view keep-alive,
+    # deferred_init.cc:427-458, in the opposite direction).
+    p_ctx = get_fake_context(fake, CONTEXT_KEY)
+    w_ctx = get_fake_context(new, CONTEXT_KEY) if is_fake(new) else None
+    if w_ctx is not None and w_ctx is not p_ctx and w_ctx not in p_ctx.views:
+        p_ctx.views.append(w_ctx)
 
 
 from . import fake as _fake_module  # noqa: E402  (install the hook)

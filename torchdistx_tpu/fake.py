@@ -179,6 +179,19 @@ class FakeTensor(torch.Tensor):
             )
 
     def __bool__(self):
+        # Value-dependent control flow on a *recorded* fake materializes it
+        # early (same protocol as the terminal ops aten::item /
+        # aten::is_nonzero, deferred_init.cc:792-797) — torch's own init
+        # helpers branch on tensor predicates (e.g. `if not mask.any()` in
+        # nn.init.trunc_normal_). A bare fake-mode fake still raises.
+        from . import _graph
+
+        if get_fake_context(self, _graph.CONTEXT_KEY) is not None:
+            # Replay must run on real tensors: pop the recording/fake modes
+            # (inside __torch_dispatch__ the mode stack is popped for us;
+            # __bool__ is plain Python, so pop it explicitly).
+            with torch.utils._python_dispatch._disable_current_modes():
+                return bool(_graph.materialize(self, retain_context=True))
         raise RuntimeError(
             "The truth value of a fake tensor cannot be determined: fake "
             "tensors have no storage. Materialize it first."

@@ -72,9 +72,22 @@ class TraceContext:
         self.base_key = base_key
         self._knr = 0
         self.used_rng = False
+        # Factory default dtype: from the op's captured thread-local state
+        # (a recording made under torch.set_default_dtype resolves factory
+        # ops recorded without an explicit dtype= the way torch would).
+        self.default_dtype = None
 
     def set_node(self, node: "OpNode") -> None:
         self._knr = node.key_nr
+        self._set_default_dtype(node)
+
+    def _set_default_dtype(self, node: "OpNode") -> None:
+        from ._dtypes import jax_dtype
+
+        tls = getattr(node.op, "tls", None)
+        self.default_dtype = (
+            jax_dtype(tls.default_dtype) if tls is not None else None
+        )
 
     def key(self):
         self.used_rng = True
@@ -94,6 +107,7 @@ class _BatchedTraceContext(TraceContext):
 
     def set_node(self, node: "OpNode") -> None:
         self._knr = self._knr_vec[self._local[id(node)]]
+        self._set_default_dtype(node)
 
 
 def _op_name(node: OpNode) -> str:
@@ -301,10 +315,14 @@ def _node_sig(node: OpNode, local_index: Dict[int, int]):
     if node.materialized:
         # Early-materialized values are instance-specific constants.
         return ("terminal", id(node))
+    tls = node.op.tls
     return (
         _op_name(node),
         _value_sig(node.op.args, node.dependencies, local_index),
         _value_sig(node.op.kwargs, node.dependencies, local_index),
+        # Replay-relevant TLS is part of the structure: two chains recorded
+        # under different default dtypes must not batch together.
+        str(tls.default_dtype),
     )
 
 

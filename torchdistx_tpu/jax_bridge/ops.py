@@ -53,6 +53,13 @@ def _dtype_of(kw, default=jnp.float32):
     return jax_dtype(d)
 
 
+def _float_default(ctx):
+    """torch resolves dtype-less float factories against the thread-local
+    default dtype; the captured per-op TLS provides it (ctx.default_dtype,
+    from Op.tls — see compile.TraceContext.set_node)."""
+    return getattr(ctx, "default_dtype", None) or jnp.float32
+
+
 # ---------------------------------------------------------------------------
 # Factories
 # ---------------------------------------------------------------------------
@@ -62,7 +69,7 @@ def _dtype_of(kw, default=jnp.float32):
 def _empty(ctx, size, **kw):
     # Uninitialized storage is indistinguishable from zeros for a correct
     # init graph (anything read before being written would be UB in torch).
-    return jnp.zeros(tuple(size), dtype=_dtype_of(kw))
+    return jnp.zeros(tuple(size), dtype=_dtype_of(kw, _float_default(ctx)))
 
 
 @_reg("aten.empty_like.default", "pure")
@@ -77,7 +84,7 @@ def _zeros_like(ctx, x, **kw):
 
 @_reg("aten.ones.default", "pure")
 def _ones(ctx, size, **kw):
-    return jnp.ones(tuple(size), dtype=_dtype_of(kw))
+    return jnp.ones(tuple(size), dtype=_dtype_of(kw, _float_default(ctx)))
 
 
 @_reg("aten.ones_like.default", "pure")
@@ -89,7 +96,7 @@ def _ones_like(ctx, x, **kw):
 def _full(ctx, size, value, **kw):
     dt = kw.get("dtype")
     if dt is None:
-        default = jnp.float32 if isinstance(value, float) else jnp.int64
+        default = _float_default(ctx) if isinstance(value, float) else jnp.int64
         return jnp.full(tuple(size), value, dtype=default)
     return jnp.full(tuple(size), value, dtype=jax_dtype(dt))
 
@@ -113,24 +120,33 @@ def _arange(ctx, *a, **kw):
     if dt is not None:
         return jnp.arange(start, end, step, dtype=jax_dtype(dt))
     if any(isinstance(x, float) for x in (start, end, step)):
-        return jnp.arange(start, end, step, dtype=jnp.float32)
+        return jnp.arange(start, end, step, dtype=_float_default(ctx))
     return jnp.arange(start, end, step, dtype=jnp.int64)
 
 
 @_reg("aten.eye.default", "pure")
 def _eye(ctx, n, m=None, **kw):
-    return jnp.eye(n, m if isinstance(m, int) else None, dtype=_dtype_of(kw))
+    return jnp.eye(n, m if isinstance(m, int) else None, dtype=_dtype_of(kw, _float_default(ctx)))
 
 
 @_reg("aten.scalar_tensor.default", "pure")
 def _scalar_tensor(ctx, v, **kw):
-    default = jnp.float32 if isinstance(v, float) else jnp.int64
+    default = _float_default(ctx) if isinstance(v, float) else jnp.int64
     return jnp.asarray(v, dtype=_dtype_of(kw, default))
 
 
 @_reg("aten.lift_fresh_copy.default", "pure")
 def _lift_fresh(ctx, x, **kw):
     return jnp.asarray(x)
+
+
+@_reg("tdx::set_data", "inplace")
+def _set_data(ctx, cur, new, **kw):
+    # `base.data = value`: the fake frontend enforces matching shape/dtype
+    # (fake._set_data), so for the init compiler this is a value rebind of
+    # the base's box.  Replay-graph aliasing after the rebind is tracked by
+    # meta storage keys, which the fake swap already shares.
+    return new
 
 
 # ---------------------------------------------------------------------------
@@ -218,7 +234,7 @@ def _randint_(ctx, cur, low=None, high=None, **kw):
 
 @_reg(["aten.rand.default"], "pure")
 def _rand(ctx, size, **kw):
-    dtype = _dtype_of(kw)
+    dtype = _dtype_of(kw, _float_default(ctx))
     return _chunked_draw(
         lambda k, s: jax.random.uniform(k, s, dtype=dtype), ctx.key(), tuple(size)
     )
@@ -226,7 +242,7 @@ def _rand(ctx, size, **kw):
 
 @_reg(["aten.randn.default"], "pure")
 def _randn(ctx, size, **kw):
-    dtype = _dtype_of(kw)
+    dtype = _dtype_of(kw, _float_default(ctx))
     return _chunked_draw(
         lambda k, s: jax.random.normal(k, s, dtype=dtype), ctx.key(), tuple(size)
     )
@@ -375,8 +391,9 @@ for name, fn in {
     "aten.tanh.default": jnp.tanh,
     "aten.sign.default": jnp.sign,
     "aten.clone.default": lambda x, **kw: jnp.asarray(x),
-    "aten.detach.default": lambda x: x,
-    "aten.alias.default": lambda x: x,
+    # detach/alias are registered below as true aliasing views (a pure
+    # identity would break write-through: `p.data.normal_()` mutates the
+    # base through the detach the .data getter records).
     "aten.contiguous.default": lambda x, **kw: x,
     "aten.tril.default": lambda x, diagonal=0: jnp.tril(x, diagonal),
     "aten.triu.default": lambda x, diagonal=0: jnp.triu(x, diagonal),
@@ -441,6 +458,11 @@ def _compose_perm_inv(perm):
     for i, p in enumerate(perm):
         inv[p] = i
     return inv
+
+
+@_reg(["aten.detach.default", "aten.alias.default"], "view")
+def _alias_view(ctx, base_shape, **kw):
+    return (lambda b: b), (lambda b, v: v)
 
 
 @_reg(["aten.view.default", "aten._unsafe_view.default", "aten.reshape.default"], "view")

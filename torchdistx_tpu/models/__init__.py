@@ -15,6 +15,7 @@ from .configs import (
     MoEConfig,
     TransformerConfig,
 )
+from .decomposition import PipelineDecomposition
 from .gpt2 import GPT2Model, make_gpt2
 from .llama import LlamaModel, make_llama
 from .mixtral import make_mixtral
@@ -37,6 +38,7 @@ __all__ = [
     "TINY_T5",
     "GPT2Model",
     "LlamaModel",
+    "PipelineDecomposition",
     "T5Model",
     "make_gpt2",
     "make_llama",

@@ -44,7 +44,7 @@ class GPT2Model(nn.Module):
         )
         (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, None), None)
 
-        x = make_norm(cfg)(x)
+        x = make_norm(cfg, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(cfg.param_dtype))
         else:
@@ -53,6 +53,40 @@ class GPT2Model(nn.Module):
                 param_dtype=cfg.param_dtype, name="lm_head",
             )(x)
         return logits.astype(jnp.float32)
+
+
+    def pipeline_decomposition(self) -> "PipelineDecomposition":
+        """Export for the pipeline runner (parallel/pipeline.py): wte+wpe
+        embedding, scan-stacked blocks, final_norm + tied/untied head."""
+        from .decomposition import (
+            PipelineDecomposition,
+            apply_final_norm,
+            decoder_head_logits,
+            token_embed,
+        )
+
+        cfg = self.cfg
+
+        def embed(p, tokens):
+            S = tokens.shape[1]
+            tok = token_embed(cfg, p["wte"], tokens)
+            pos = nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+            ).apply({"params": p["wpe"]}, jnp.arange(S, dtype=jnp.int32))
+            return tok + pos[None]
+
+        def block_params(p):
+            return p["blocks"]["block"]
+
+        def angles(S):
+            return None  # learned absolute positions, applied at embed
+
+        def head(p, x):
+            x = apply_final_norm(cfg, p, x)
+            return decoder_head_logits(cfg, p, x, p["wte"]["embedding"])
+
+        return PipelineDecomposition(embed, block_params, angles, head)
 
 
 def make_gpt2(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> GPT2Model:

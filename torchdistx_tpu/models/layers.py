@@ -83,8 +83,10 @@ class LayerNorm(nn.Module):
         return (y * scale + bias).astype(dtype)
 
 
-def make_norm(cfg: TransformerConfig):
-    return RMSNorm(eps=cfg.norm_eps) if cfg.norm == "rmsnorm" else LayerNorm(eps=cfg.norm_eps)
+def make_norm(cfg: TransformerConfig, name: str | None = None):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(eps=cfg.norm_eps, name=name)
+    return LayerNorm(eps=cfg.norm_eps, name=name)
 
 
 def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:

@@ -64,7 +64,7 @@ class LlamaModel(nn.Module):
         )
         (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, angles), None)
 
-        x = make_norm(cfg)(x)
+        x = make_norm(cfg, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(cfg.param_dtype))
         else:
@@ -76,6 +76,34 @@ class LlamaModel(nn.Module):
                 name="lm_head",
             )(x)
         return logits.astype(jnp.float32)
+
+
+    def pipeline_decomposition(self) -> "PipelineDecomposition":
+        """Export for the pipeline runner (parallel/pipeline.py); mirrors
+        __call__'s embed → blocks → final_norm/head structure."""
+        from .decomposition import (
+            PipelineDecomposition,
+            apply_final_norm,
+            decoder_head_logits,
+            token_embed,
+        )
+
+        cfg = self.cfg
+
+        def embed(p, tokens):
+            return token_embed(cfg, p["embed"], tokens)
+
+        def block_params(p):
+            return p["blocks"]["block"]
+
+        def angles(S):
+            return rope_frequencies(cfg.head_size, S, cfg.rope_theta)
+
+        def head(p, x):
+            x = apply_final_norm(cfg, p, x)
+            return decoder_head_logits(cfg, p, x, p["embed"]["embedding"])
+
+        return PipelineDecomposition(embed, block_params, angles, head)
 
 
 def make_llama(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> LlamaModel:

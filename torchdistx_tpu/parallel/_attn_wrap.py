@@ -15,37 +15,45 @@ def wrap_seq_parallel_attn(
     *,
     name: str,
     spec: P,
-    per_device: Callable,  # (q, k, v, causal) -> out, runs inside shard_map
+    per_device: Callable,  # (q, k, v, causal, bias) -> out, inside shard_map
     validate: Optional[Callable] = None,  # (q, k, v) -> None, raises on misuse
+    bias_spec: Optional[P] = None,  # how [H, S_q, S_k] bias shards, or None
 ):
     """Build a model-facing ``AttnFn`` that shard_maps ``per_device``.
 
     Global [B, S, H, D] arrays are partitioned by ``spec``; one shard_map
-    is built per causality so the mapped callable stays jit-cacheable.
-    Additive bias is rejected here — it cannot be resharded correctly by
-    either strategy.
+    is built per (causality, has-bias) so the mapped callable stays
+    jit-cacheable.  Additive [H, S_q, S_k] bias is partitioned by
+    ``bias_spec`` when the strategy supports it (ring attention shards the
+    query rows and block-slices the key columns); strategies that cannot
+    reshard a bias leave ``bias_spec=None`` and reject it.
     """
 
-    def _build(causal: bool):
+    def _build(causal: bool, with_bias: bool):
+        in_specs = (spec, spec, spec) + ((bias_spec,) if with_bias else ())
+
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=in_specs,
             out_specs=spec,
             check_vma=False,
         )
-        def _sharded(q, k, v):
-            return per_device(q, k, v, causal)
+        def _sharded(q, k, v, *maybe_bias):
+            return per_device(q, k, v, causal, maybe_bias[0] if maybe_bias else None)
 
         return _sharded
 
-    fns = {True: _build(True), False: _build(False)}
+    fns = {}
 
     def attn_fn(q, k, v, *, causal=True, bias=None):
-        if bias is not None:
+        if bias is not None and bias_spec is None:
             raise NotImplementedError(f"{name} does not support bias")
         if validate is not None:
             validate(q, k, v)
-        return fns[causal](q, k, v)
+        key = (causal, bias is not None)
+        if key not in fns:
+            fns[key] = _build(*key)
+        return fns[key](q, k, v) if bias is None else fns[key](q, k, v, bias)
 
     return attn_fn

@@ -13,7 +13,8 @@ Entry points:
 
 * :func:`pipeline_forward` — the per-device schedule, inside ``shard_map``;
 * :func:`pipelined_decoder_apply` — full decoder LM forward (embed →
-  pipelined blocks → norm/head) for LlamaModel/GPT2Model param trees;
+  pipelined blocks → norm/head) driven by the model family's exported
+  :class:`~torchdistx_tpu.models.decomposition.PipelineDecomposition`;
 * :func:`pipeline_plan_overrides` — plan rules putting the layer dim of
   block params on ``pp`` so deferred-init materializes each stage's layers
   straight onto its own devices.
@@ -24,7 +25,6 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable, Optional
 
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -32,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..models.configs import TransformerConfig
-from ..models.layers import Block, default_attention, make_norm, rope_frequencies
+from ..models.layers import Block, default_attention
 from .collectives import send_next
 
 
@@ -94,42 +94,46 @@ def pipelined_decoder_apply(
     tokens: jax.Array,  # [B, S]
     mesh: Mesh,
     *,
+    decomp=None,
     n_microbatches: int = 4,
     axis_name: str = "pp",
     attn_fn=default_attention,
-    positions: str = "rope",
+    positions: Optional[str] = None,  # None = follow cfg.positions
 ):
     """Full decoder-LM forward with pipelined blocks.
 
     Embedding and head run replicated across stages (their params are
     small relative to the blocks); the blocks' layer dim is sharded over
-    ``pp``.  Works for LlamaModel ('embed') and GPT2Model ('wte'/'wpe')
-    param trees.
+    ``pp``.  ``decomp`` is the family's exported
+    :class:`~torchdistx_tpu.models.decomposition.PipelineDecomposition`
+    (``model.pipeline_decomposition()``); when omitted, the stock families
+    are resolved from ``cfg.positions`` ("rope" → Llama/Mixtral layout,
+    else GPT-2) — custom families must pass their own.
     """
+    if decomp is None:
+        from ..models.gpt2 import GPT2Model
+        from ..models.llama import LlamaModel
+
+        if positions is not None and positions != cfg.positions:
+            import warnings
+
+            warnings.warn(
+                f"pipelined_decoder_apply: positions={positions!r} conflicts "
+                f"with cfg.positions={cfg.positions!r}; the config wins. "
+                f"Pass decomp= (model.pipeline_decomposition()) to override "
+                f"the family explicitly."
+            )
+        family = LlamaModel if cfg.positions == "rope" else GPT2Model
+        decomp = family(cfg, attn_fn=attn_fn).pipeline_decomposition()
+
     p = params["params"]
     B, S = tokens.shape
     assert B % n_microbatches == 0, (
         f"n_microbatches ({n_microbatches}) must divide the batch size ({B})"
     )
 
-    if "embed" in p:
-        emb_mod = nn.Embed(
-            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
-        )
-        x = emb_mod.apply({"params": p["embed"]}, tokens)
-        embed_table = p["embed"]["embedding"]
-    else:  # gpt2
-        emb_mod = nn.Embed(
-            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
-        )
-        x = emb_mod.apply({"params": p["wte"]}, tokens)
-        x = x + nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
-        ).apply({"params": p["wpe"]}, jnp.arange(S, dtype=jnp.int32))[None]
-        embed_table = p["wte"]["embedding"]
-
-    angles = rope_frequencies(cfg.head_size, S, cfg.rope_theta) if positions == "rope" else None
-    chain = _block_chain(cfg, attn_fn, angles)
+    x = decomp.embed(p, tokens)
+    chain = _block_chain(cfg, attn_fn, decomp.angles(S))
 
     x_mb = x.reshape(n_microbatches, B // n_microbatches, S, cfg.d_model)
 
@@ -141,17 +145,11 @@ def pipelined_decoder_apply(
         axis_names={axis_name},
         check_vma=False,
     )
-    y = pp_fn(p["blocks"]["block"], x_mb)
+    y = pp_fn(decomp.block_params(p), x_mb)
     x = y.reshape(B, S, cfg.d_model)
 
     # final norm + head (replicated compute)
-    norm_key = next(k for k in p.keys() if "Norm" in k)
-    x = make_norm(cfg).apply({"params": p[norm_key]}, x)
-    if cfg.tie_embeddings or "lm_head" not in p:
-        logits = x.astype(cfg.param_dtype) @ embed_table.T
-    else:
-        logits = x @ p["lm_head"]["kernel"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    return decomp.head(p, x)
 
 
 def pipeline_plan_overrides(axis_name: str = "pp"):

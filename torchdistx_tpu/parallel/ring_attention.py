@@ -41,15 +41,17 @@ def ring_attention(
     causal: bool = True,
     bias: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Exact attention over the ring; call inside ``shard_map``."""
-    if bias is not None:
-        raise NotImplementedError(
-            "ring_attention does not support additive attention bias yet; "
-            "use default_attention for relative-position-bias models."
-        )
+    """Exact attention over the ring; call inside ``shard_map``.
+
+    ``bias`` (additive, T5-style relative positions) arrives sharded over
+    the *query* rows: local shape [H, s, T_total].  Each ring step slices
+    the key-block columns out of it — O(H·s·T/n) memory per device, no
+    rotation needed since the full key extent is resident per row strip.
+    """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, s, H, D = q.shape
+    t = k.shape[1]  # local key/value block length (cross-attn: != s)
     KV = k.shape[2]
     G = H // KV
 
@@ -64,12 +66,18 @@ def ring_attention(
         o, m, l, k_cur, v_cur = carry
         src = (idx - i) % n  # which global block k_cur holds
         logits = jnp.einsum("bskgd,btkd->bkgst", qf, k_cur.astype(jnp.float32))
+        if bias is not None:
+            blk = lax.dynamic_slice_in_dim(bias, src * t, t, axis=2)  # [H, s, t]
+            logits = logits + blk.reshape(KV, G, s, t)[None].astype(jnp.float32)
         if causal:
-            k_pos = src * s + jnp.arange(s)
-            mask = (q_pos[:, None] >= k_pos[None, :]).astype(jnp.float32)
+            # Bottom-right alignment, matching the dense oracle's
+            # tril(k=T-S): query i attends keys <= i + (T_total - S_total).
+            k_pos = src * t + jnp.arange(t)
+            offset = (t - s) * n
+            mask = (q_pos[:, None] + offset >= k_pos[None, :]).astype(jnp.float32)
             logits = jnp.where(mask[None, None, None].astype(bool), logits, _NEG)
         else:
-            mask = jnp.ones((s, s), jnp.float32)
+            mask = jnp.ones((s, t), jnp.float32)
         blk_max = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)
@@ -112,7 +120,10 @@ def make_ring_attention(
         mesh,
         name="ring attention",
         spec=P(b, seq_axis, h, None),
-        per_device=lambda q, k, v, causal: ring_attention(
-            q, k, v, axis_name=seq_axis, causal=causal
+        # [H, S_q, S_k] bias: heads over tp, query rows over sp, full key
+        # extent resident (ring steps slice the key-block columns).
+        bias_spec=P(h, seq_axis, None),
+        per_device=lambda q, k, v, causal, bias: ring_attention(
+            q, k, v, axis_name=seq_axis, causal=causal, bias=bias
         ),
     )

@@ -74,10 +74,16 @@ def make_train_step(
         )
     batch_sharding = NamedSharding(mesh, P(baxes if baxes else None, None))
 
+    decomp = (
+        model.pipeline_decomposition()
+        if pipeline and hasattr(model, "pipeline_decomposition")
+        else None
+    )
+
     def forward(params, tokens):
         if pipeline:
             logits = pipelined_decoder_apply(
-                cfg, params, tokens, mesh,
+                cfg, params, tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
                 attn_fn=attn_fn or default_attention,
                 positions=cfg.positions,

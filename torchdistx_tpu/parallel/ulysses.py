@@ -51,11 +51,6 @@ def ulysses_attention(
     Call inside ``shard_map``. ``inner_attn`` is any ``AttnFn``; default is
     the plain XLA attention (callers on TPU pass the flash kernel).
     """
-    if bias is not None:
-        raise NotImplementedError(
-            "ulysses attention does not support bias: a per-head bias "
-            "cannot be resharded through the head all-to-all"
-        )
     if inner_attn is None:
         from ..models.layers import default_attention
 
@@ -81,7 +76,15 @@ def ulysses_attention(
     # [B, s, H, D] -> [B, S, H/n, D]: split heads, gather sequence.
     gather = lambda x: all_to_all(x, axis_name, split_dim=2, concat_dim=1)
     qg, kg, vg = gather(q), gather(k), gather(v)
-    out = inner_attn(qg, kg, vg, causal=causal)
+    # bias arrives pre-sharded head-wise ([H/n, S, T] local — the same
+    # contiguous head chunk this device owns after the all-to-all), so it
+    # feeds the full-sequence inner attention with no resharding.  Only
+    # pass it through when present: bias-less inner_attn callables (the
+    # original AttnFn protocol) remain valid.
+    if bias is None:
+        out = inner_attn(qg, kg, vg, causal=causal)
+    else:
+        out = inner_attn(qg, kg, vg, causal=causal, bias=bias)
     # [B, S, H/n, D] -> [B, s, H, D]: split sequence, gather heads.
     return all_to_all(out, axis_name, split_dim=1, concat_dim=2)
 
@@ -119,8 +122,12 @@ def make_ulysses_attention(
         mesh,
         name="ulysses attention",
         spec=P(b, seq_axis, None, None),
-        per_device=lambda q, k, v, causal: ulysses_attention(
-            q, k, v, axis_name=seq_axis, causal=causal, inner_attn=inner_attn
+        # [H, S_q, S_k] bias: heads over sp (the post-all-to-all layout),
+        # full sequence extents resident per head slice.
+        bias_spec=P(seq_axis, None, None),
+        per_device=lambda q, k, v, causal, bias: ulysses_attention(
+            q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
+            inner_attn=inner_attn,
         ),
         validate=validate,
     )

@@ -40,7 +40,9 @@ from .fake import FakeTensor, get_fake_context, is_fake, set_fake_context
 
 __all__ = ["save_recording", "load_recording"]
 
-_FORMAT_VERSION = 1
+# v2 added the full per-op thread-local-state capture ("tls"); v1 files
+# (grad mode only) still load, with default-TLS for the other fields.
+_FORMAT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -118,7 +120,39 @@ def _decode(obj, tensors: List[torch.Tensor]):
     return obj
 
 
+def _encode_tls(tls: _graph.ThreadLocalState, tensors) -> dict:
+    return {
+        "grad_enabled": tls.grad_enabled,
+        "autocast": _encode(tls.autocast, tensors),
+        "autocast_cache_enabled": tls.autocast_cache_enabled,
+        "default_dtype": _encode_leaf(tls.default_dtype, tensors),
+    }
+
+
+def _decode_tls(rec: dict, tensors) -> _graph.ThreadLocalState:
+    if "tls" not in rec:  # v1 file: grad mode only, neutral for the rest
+        neutral = {"cpu": torch.bfloat16, "cuda": torch.float16}
+        return _graph.ThreadLocalState(
+            grad_enabled=rec["grad_enabled"],
+            autocast=tuple(
+                (d, False, dt) for d, dt in neutral.items()
+            ),
+            autocast_cache_enabled=True,
+            default_dtype=torch.float32,
+        )
+    t = rec["tls"]
+    return _graph.ThreadLocalState(
+        grad_enabled=t["grad_enabled"],
+        autocast=_decode(t["autocast"], tensors),
+        autocast_cache_enabled=t["autocast_cache_enabled"],
+        default_dtype=_decode(t["default_dtype"], tensors),
+    )
+
+
 def _encode_func(func) -> Dict[str, str]:
+    for syn_name, syn_fn in _graph.SYNTHETIC_OPS.items():
+        if func is syn_fn:
+            return {"synthetic": syn_name}
     schema_name = getattr(getattr(func, "_schema", None), "name", None)
     overload = getattr(func, "_overloadname", None)
     if schema_name is None or overload is None:
@@ -131,6 +165,13 @@ def _encode_func(func) -> Dict[str, str]:
 
 
 def _decode_func(ref: Dict[str, str]):
+    if "synthetic" in ref:
+        try:
+            return _graph.SYNTHETIC_OPS[ref["synthetic"]]
+        except KeyError:
+            raise RuntimeError(
+                f"Recording uses unknown synthetic op `{ref['synthetic']}`."
+            ) from None
     packet = getattr(torch.ops, ref["ns"])
     op = getattr(packet, ref["name"])
     return getattr(op, ref["overload"])
@@ -211,7 +252,7 @@ def save_recording(obj: Union[torch.nn.Module, Dict[str, torch.Tensor]], path) -
                 "name": n.op.name,
                 "args": _encode(n.op.args, tensors),
                 "kwargs": _encode(n.op.kwargs, tensors),
-                "grad_enabled": n.op.grad_enabled,
+                "tls": _encode_tls(n.op.tls, tensors),
                 "key_nr": n.key_nr,
                 "deps": [(index[id(dep)], out) for dep, out in n.dependencies],
                 "storages": sorted(sid(k) for k in n.storages),
@@ -273,7 +314,7 @@ def load_recording(path) -> Dict[str, FakeTensor]:
             func=_decode_func(rec["func"]),
             args=_decode(rec["args"], tensors),
             kwargs=_decode(rec["kwargs"], tensors),
-            grad_enabled=rec["grad_enabled"],
+            tls=_decode_tls(rec, tensors),
             name=rec["name"],
         )
         node = OpNode(op, key_nr=rec["key_nr"])
