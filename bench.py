@@ -150,6 +150,64 @@ def phase_llama_baseline() -> dict:
     return _phase_baseline(LlamaForCausalLM, _llama_config())
 
 
+def _phase_sharded(model_cls, config) -> dict:
+    """deferred_init → sharded materialization over an 8-device virtual
+    CPU mesh (BASELINE configs 4-5 run on pod slices; the virtual mesh
+    proves the same sharded program end-to-end on this single-host
+    driver).  Runs in a subprocess with the forced CPU platform."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    os.environ["TDX_BENCH_PLATFORM"] = "cpu"
+    jax = _init_jax(cache=True)
+    from torchdistx_tpu.deferred_init import deferred_init
+    from torchdistx_tpu.jax_bridge import materialize_module_jax
+    from torchdistx_tpu.parallel import fsdp_plan, make_mesh
+
+    mesh = make_mesh({"fsdp": 4, "tp": 2})
+    # HF torch param names (encoder.block.0...weight) — use the
+    # name-agnostic size-based plan, as a torchdistX user would.
+    plan = fsdp_plan(min_size=4096)
+    t0 = time.perf_counter()
+    m = deferred_init(model_cls, config)
+    params = materialize_module_jax(m, mesh=mesh, plan=plan, seed=0)
+    jax.block_until_ready(params)
+    return {
+        "t": time.perf_counter() - t0,
+        "rss_mb": _rss_mb(),
+        "n_params": sum(int(v.size) for v in params.values()),
+        "n_sharded": sum(
+            1 for v in params.values()
+            if not getattr(v.sharding, "is_fully_replicated", True)
+        ),
+    }
+
+
+def phase_t5_sharded() -> dict:
+    from transformers import T5Config, T5ForConditionalGeneration
+
+    # T5-11B's structure at a virtual-mesh-friendly size (BASELINE cfg 4).
+    return _phase_sharded(
+        T5ForConditionalGeneration,
+        T5Config(d_model=512, d_ff=2048, num_layers=8, num_heads=8,
+                 vocab_size=32128, d_kv=64),
+    )
+
+
+def phase_mixtral_sharded() -> dict:
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    # Mixtral 8x7B's structure: 8 experts per layer (BASELINE cfg 5).
+    return _phase_sharded(
+        MixtralForCausalLM,
+        MixtralConfig(hidden_size=256, intermediate_size=512,
+                      num_hidden_layers=4, num_attention_heads=8,
+                      num_key_value_heads=4, vocab_size=32000,
+                      num_local_experts=8, num_experts_per_tok=2),
+    )
+
+
 def phase_flash() -> dict:
     """Flash-attention fwd vs stock attention on the default device;
     reports achieved TFLOP/s (compiled path, interpret=False on TPU)."""
@@ -192,6 +250,8 @@ PHASES = {
     "gpt2_ours": phase_gpt2_ours,
     "llama_ours": phase_llama_ours,
     "llama_baseline": phase_llama_baseline,
+    "t5_sharded": phase_t5_sharded,
+    "mixtral_sharded": phase_mixtral_sharded,
     "flash": phase_flash,
 }
 
@@ -203,7 +263,8 @@ def _run_phase(name: str, timeout: float = 600.0):
             capture_output=True, text=True, cwd=REPO, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"phase {name} timed out after {timeout:.0f}s"}
+        return {"error": f"phase {name} timed out after {timeout:.0f}s",
+                "timeout_s": timeout}
     if res.returncode != 0:
         return {"error": (res.stderr or res.stdout).strip()[-400:]}
     try:
@@ -219,6 +280,8 @@ def main() -> None:
 
     base = _run_phase("gpt2_baseline")
     ours = _run_phase("gpt2_ours")
+    if "error" in ours:  # one retry: transient tunnel stalls happen
+        ours = _run_phase("gpt2_ours")
     if "error" in ours:
         print(json.dumps({"metric": "bench failed", "value": 0, "unit": "s",
                           "vs_baseline": 0, "detail": ours["error"]}))
@@ -245,10 +308,31 @@ def main() -> None:
             out["llama_1p9b_baseline_s"] = round(llama_base["t"], 3)
             out["llama_1p9b_baseline_rss_mb"] = round(llama_base["rss_mb"], 1)
             out["llama_1p9b_vs_baseline"] = round(llama_base["t"] / llama_ours["t"], 3)
+        elif "timeout_s" in llama_base:
+            # The eager path (torch CPU init of 1.5B params + 5.9 GB of
+            # host→device transfers) did not finish inside the budget;
+            # report the measured lower bound instead of dropping it.
+            out["llama_1p9b_baseline_s"] = None
+            out["llama_1p9b_baseline_timeout_s"] = llama_base["timeout_s"]
+            out["llama_1p9b_vs_baseline_at_least"] = round(
+                llama_base["timeout_s"] / llama_ours["t"], 1
+            )
+        else:
+            out["llama_baseline_error"] = llama_base["error"][-160:]
     else:
         out["llama_error"] = llama_ours["error"][-160:]
 
-    flash = _run_phase("flash", timeout=900.0)
+    for name in ("t5_sharded", "mixtral_sharded"):
+        r = _run_phase(name, timeout=420.0)
+        if "error" not in r:
+            out[f"{name}_s"] = round(r["t"], 3)
+            out[f"{name}_rss_mb"] = round(r["rss_mb"], 1)
+            out[f"{name}_n_params"] = r.get("n_params")
+            out[f"{name}_n_sharded"] = r.get("n_sharded")
+        else:
+            out[f"{name}_error"] = r["error"][-160:]
+
+    flash = _run_phase("flash", timeout=480.0)
     if "error" not in flash:
         out.update({f"flash_{k}" if not k.startswith(("flash", "ref")) else k: v
                     for k, v in flash.items()})

@@ -1,0 +1,140 @@
+"""Failure detection + elastic checkpoint-restart recovery, and the
+runtime config surface (SURVEY.md §5 rows the reference lacks)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import torchdistx_tpu.config as tdx_config
+from torchdistx_tpu.utils.failures import (
+    FailureDetector,
+    device_health,
+    run_elastic,
+)
+
+
+class TestDeviceHealth:
+    def test_all_healthy(self):
+        report = device_health()
+        assert report["healthy"]
+        assert len(report["devices"]) == len(jax.devices())
+        assert all(e["ok"] and e["latency_ms"] is not None for e in report["devices"])
+
+    def test_detector_threshold_and_recovery(self, monkeypatch):
+        calls = []
+        det = FailureDetector(threshold=2, on_failure=lambda r: calls.append(r))
+        healthy = {"healthy": True, "devices": []}
+        sick = {"healthy": False, "devices": [{"ok": False}]}
+        seq = iter([sick, sick, sick, healthy, sick])
+        monkeypatch.setattr(
+            "torchdistx_tpu.utils.failures.device_health",
+            lambda devices=None: next(seq),
+        )
+        assert det.check() is False
+        assert not calls  # below threshold
+        assert det.check() is False
+        assert len(calls) == 1  # fired exactly once at the threshold
+        assert det.check() is False
+        assert len(calls) == 1  # no refire while still down
+        assert det.check() is True  # recovered; counter resets
+        assert det.check() is False
+        assert det.consecutive_failures == 1
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+class TestRunElastic:
+    def _step(self, fail_at):
+        seen = {"n": 0}
+
+        def step(state, batch):
+            seen["n"] += 1
+            if seen["n"] in fail_at:
+                raise _Boom(f"injected at call {seen['n']}")
+            return {"x": state["x"] + batch}, {"loss": float(state["x"])}
+
+        return step
+
+    def test_recovers_from_injected_failure(self, tmp_path):
+        state = {"x": jnp.float32(0.0)}
+        batches = [jnp.float32(i) for i in range(1, 7)]
+        # fail on the 4th call; checkpoint every 2 steps
+        step = self._step(fail_at={4})
+        out, steps, restarts = run_elastic(
+            step, state, batches,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            retry_on=(_Boom,), max_restarts=2,
+        )
+        assert steps == 6
+        assert restarts == 1
+        # replay is deterministic: sum 1..6 regardless of the restart
+        assert float(out["x"]) == 21.0
+
+    def test_budget_exhaustion_reraises(self, tmp_path):
+        state = {"x": jnp.float32(0.0)}
+        step = self._step(fail_at={2, 3, 4, 5, 6, 7, 8, 9})
+        with pytest.raises(_Boom):
+            run_elastic(
+                step, state, [jnp.float32(1.0)] * 5,
+                checkpoint_dir=str(tmp_path), checkpoint_every=1,
+                retry_on=(_Boom,), max_restarts=2,
+            )
+
+    def test_unlisted_exception_fails_fast(self, tmp_path):
+        def step(state, batch):
+            raise ValueError("a real bug, not a device failure")
+
+        with pytest.raises(ValueError):
+            run_elastic(
+                step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)],
+                checkpoint_dir=str(tmp_path), retry_on=(_Boom,),
+            )
+
+    def test_no_checkpoint_dir_raises_on_failure(self):
+        step = self._step(fail_at={1})
+        with pytest.raises(RuntimeError, match="no checkpoint"):
+            run_elastic(
+                step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)], retry_on=(_Boom,)
+            )
+
+
+class TestConfig:
+    def test_defaults_from_env(self):
+        cfg = tdx_config.get()
+        assert isinstance(cfg.native, bool)
+        assert cfg.rng_chunk_elems > 0
+
+    def test_override_scoped_and_nested(self):
+        base = tdx_config.get().rng_chunk_elems
+        with tdx_config.override(rng_chunk_elems=42):
+            assert tdx_config.get().rng_chunk_elems == 42
+            with tdx_config.override(native=False):
+                assert tdx_config.get().rng_chunk_elems == 42
+                assert tdx_config.get().native is False
+            assert tdx_config.get().rng_chunk_elems == 42
+        assert tdx_config.get().rng_chunk_elems == base
+
+    def test_override_disables_native_walks(self):
+        import torch
+
+        from torchdistx_tpu import _native
+        from torchdistx_tpu._graph import CONTEXT_KEY
+        from torchdistx_tpu.deferred_init import deferred_init, materialize_tensor
+        from torchdistx_tpu.fake import get_fake_context
+
+        with tdx_config.override(native=False):
+            assert not _native.available()
+            t = deferred_init(lambda: torch.ones(3) * 2)
+            ctx = get_fake_context(t, CONTEXT_KEY)
+            assert ctx.node._ng is None  # recorded without a native mirror
+            assert torch.equal(materialize_tensor(t), torch.full((3,), 2.0))
+
+    def test_set_flags_process_wide(self):
+        before = tdx_config.get().log_level
+        try:
+            tdx_config.set_flags(log_level="DEBUG")
+            assert tdx_config.get().log_level == "DEBUG"
+        finally:
+            tdx_config.set_flags(log_level=before)

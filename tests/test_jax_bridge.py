@@ -277,3 +277,18 @@ class TestSyntheticOps:
         t = deferred_init(make)
         arr = materialize_tensor_jax(t)
         assert arr.dtype == jnp.bfloat16
+
+    def test_set_data_then_inplace_through_rhs(self):
+        # After `p.data = w`, mutations through w must be visible through
+        # p in the JAX lowering too (boxes are aliased, not value-copied).
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(3, 3, bias=False)
+                w = torch.zeros(3, 3)
+                self.lin.weight.data = w
+                w.fill_(2.5)
+
+        m = deferred_init(M)
+        p = materialize_module_jax(m, seed=0)
+        assert np.allclose(np.asarray(p["lin.weight"]), 2.5)

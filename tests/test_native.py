@@ -89,3 +89,33 @@ class TestNativeEquivalence:
         materialize_module(m)
         ours = float(torch.cat([p.flatten() for p in m.parameters()]).sum())
         assert abs(ours - float(r.stdout.strip())) < 1e-6
+
+
+@needs_native
+class TestMixedNativePython:
+    def test_python_only_mutation_poisons_native_graph(self):
+        # A node recorded under config.override(native=False) extends a
+        # graph whose earlier nodes have native mirrors; the mirrors no
+        # longer see the full topology and must be poisoned so walks fall
+        # back to (correct) Python paths.
+        import torchdistx_tpu.config as tdx_config
+        from torchdistx_tpu.deferred_init import materialize_module  # noqa: F401
+
+        def make():
+            w = torch.zeros(4)
+            return w
+
+        w = deferred_init(make)
+        zeros_node = get_fake_context(w, CONTEXT_KEY).node
+        assert zeros_node._ng is not None
+        with tdx_config.override(native=False):
+            from torchdistx_tpu.deferred_init import enable_deferred_init
+
+            enable_deferred_init(True)
+            try:
+                w.fill_(7.0)  # python-only node mutating the native graph
+            finally:
+                enable_deferred_init(False)
+        assert zeros_node._ng.poisoned
+        out = materialize_tensor(w)
+        assert torch.equal(out, torch.full((4,), 7.0))

@@ -304,6 +304,13 @@ class OpNode:
         are poisoned (their nodes fall back to the Python walks, which use
         the process-global op_nr ordering and remain correct)."""
         if self._ng is None:
+            # Python-only node (e.g. recorded under config.override(
+            # native=False)) mutating/extending graphs that DO have native
+            # mirrors: those mirrors no longer see the full topology, so
+            # poison them (their walks fall back to the Python paths).
+            for dep, _ in self.dependencies:
+                if dep._ng is not None:
+                    dep._ng.poisoned = True
             return
         foreign = [dep for dep, _ in self.dependencies if dep._ng is not self._ng]
         if foreign:

@@ -58,7 +58,11 @@ LIB = _load()
 
 
 def available() -> bool:
-    return LIB is not None
+    if LIB is None:
+        return False
+    from . import config
+
+    return config.get().native
 
 
 class NativeGraph:

@@ -1,0 +1,87 @@
+"""Framework-level runtime configuration.
+
+The reference's configuration is build-time only (CMake ``TORCHDIST_*``
+options, SURVEY.md §5 "Config / flag system"); its runtime API is bare
+boolean toggles.  Here the runtime knobs live in one typed, documented
+surface, resolved from environment variables once at import and
+overridable per-scope::
+
+    import torchdistx_tpu.config as tdx_config
+    print(tdx_config.get())                # effective config
+    with tdx_config.override(native=False):
+        ...                                # Python graph walks only
+
+Environment variables (read at first import):
+
+======================  ====================================================
+``TDX_NATIVE``          "0" disables the C++ graph engine (default on when
+                        the library is built).
+``TDX_CACHE_DIR``       Persistent XLA compilation-cache directory used by
+                        the jax bridge's materializers ("" disables).
+``TDX_RNG_CHUNK``       Row-chunk element count for large RNG draws in the
+                        jax bridge (compile-time control; see
+                        jax_bridge/ops.py).
+``TDX_LOG_LEVEL``       Logging level name for the framework logger.
+======================  ====================================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, replace
+from typing import Iterator, Optional
+
+__all__ = ["Config", "get", "override", "set_flags"]
+
+
+@dataclass(frozen=True)
+class Config:
+    native: bool = True
+    cache_dir: Optional[str] = None
+    rng_chunk_elems: int = 1 << 20
+    log_level: str = "INFO"
+
+
+def _from_env() -> Config:
+    cache = os.environ.get("TDX_CACHE_DIR", "")
+    return Config(
+        native=os.environ.get("TDX_NATIVE", "1") != "0",
+        cache_dir=cache or None,
+        rng_chunk_elems=int(os.environ.get("TDX_RNG_CHUNK", str(1 << 20))),
+        log_level=os.environ.get("TDX_LOG_LEVEL", "INFO"),
+    )
+
+
+_lock = threading.Lock()
+_base = _from_env()
+_tls = threading.local()
+
+
+def get() -> Config:
+    """The effective config (innermost :func:`override` scope, else the
+    process-wide base)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else _base
+
+
+def set_flags(**kw) -> Config:
+    """Permanently update the process-wide base config."""
+    global _base
+    with _lock:
+        _base = replace(_base, **kw)
+        return _base
+
+
+@contextlib.contextmanager
+def override(**kw) -> Iterator[Config]:
+    """Thread-local scoped override: ``with override(native=False): ...``"""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(replace(get(), **kw))
+    try:
+        yield stack[-1]
+    finally:
+        stack.pop()

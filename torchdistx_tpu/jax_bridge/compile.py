@@ -175,6 +175,21 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         return
 
     name = _op_name(node)
+    if name == "tdx::set_data":
+        # `base.data = value` rebinds base's storage to value's: alias the
+        # BOXES, not just the value — later mutations through either side
+        # must be visible through the other (torch replay gets this from
+        # real set_data; the box env needs it made explicit).
+        from .._graph import _Dep
+
+        rhs = node.op.args[1]
+        if isinstance(rhs, _Dep):
+            dep, idx = node.dependencies[rhs.index]
+            env[(id(node), 0)] = _dep_box(dep, idx, env)
+        else:
+            env[(id(node), 0)] = Box(jnp.asarray(to_numpy(rhs)))
+        return
+
     entry = TABLE.get(name)
     if entry is None:
         raise NotImplementedError(

@@ -50,7 +50,27 @@ def _compiler_options() -> Optional[dict]:
     return _INIT_COMPILER_OPTIONS if _options_supported else None
 
 
+_cache_enabled = False
+
+
+def _maybe_enable_cache() -> None:
+    """Point jax's persistent compilation cache at config.cache_dir
+    (TDX_CACHE_DIR) so repeated materializations of the same model skip
+    XLA compilation — the dominant cost of the cold path."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    from .. import config
+
+    cache_dir = config.get().cache_dir
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        _cache_enabled = True
+
+
 def _run_init(init_fn, key, out_shardings=None):
+    _maybe_enable_cache()
     if out_shardings is not None:
         jitted = jax.jit(init_fn, out_shardings=out_shardings)
     else:

@@ -140,13 +140,9 @@ def _lift_fresh(ctx, x, **kw):
     return jnp.asarray(x)
 
 
-@_reg("tdx::set_data", "inplace")
-def _set_data(ctx, cur, new, **kw):
-    # `base.data = value`: the fake frontend enforces matching shape/dtype
-    # (fake._set_data), so for the init compiler this is a value rebind of
-    # the base's box.  Replay-graph aliasing after the rebind is tracked by
-    # meta storage keys, which the fake swap already shares.
-    return new
+# tdx::set_data has no table entry: it rebinds the base's *box* to the
+# rhs's box (true aliasing) and is handled directly in
+# compile.interpret_node before table dispatch.
 
 
 # ---------------------------------------------------------------------------
@@ -171,16 +167,20 @@ _CHUNK_ELEMS = 1 << 20
 def _chunked_draw(sample, key, shape):
     """``sample(key, shape)`` for big shapes: scan over row chunks so XLA
     compile cost is O(chunk), not O(total elements)."""
+    from .. import config
+
+    chunk_elems = config.get().rng_chunk_elems
+    chunk_trigger = max(_CHUNK_TRIGGER, chunk_elems)
     shape = tuple(shape)
     n = 1
     for s in shape:
         n *= s
-    if n <= _CHUNK_TRIGGER or not shape:
+    if n <= chunk_trigger or not shape:
         return sample(key, shape)
     rows, row = shape[0], n // shape[0]
-    if row > _CHUNK_ELEMS:  # single rows exceed the chunk: draw whole
+    if row > chunk_elems:  # single rows exceed the chunk: draw whole
         return sample(key, shape)
-    cr = max(1, _CHUNK_ELEMS // row)
+    cr = max(1, chunk_elems // row)
     k = -(-rows // cr)
     if k < 2:
         return sample(key, shape)

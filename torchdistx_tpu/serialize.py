@@ -130,20 +130,24 @@ def _encode_tls(tls: _graph.ThreadLocalState, tensors) -> dict:
 
 
 def _decode_tls(rec: dict, tensors) -> _graph.ThreadLocalState:
+    # On torch < 2.4 restore() cannot drive device-typed autocast at all
+    # (capture() degrades the same way), so decode no autocast entries —
+    # including from v2 files written by a newer torch.
+    has_autocast = _graph.ThreadLocalState._HAS_DEVICE_AUTOCAST
     if "tls" not in rec:  # v1 file: grad mode only, neutral for the rest
         neutral = {"cpu": torch.bfloat16, "cuda": torch.float16}
         return _graph.ThreadLocalState(
             grad_enabled=rec["grad_enabled"],
             autocast=tuple(
                 (d, False, dt) for d, dt in neutral.items()
-            ),
+            ) if has_autocast else (),
             autocast_cache_enabled=True,
             default_dtype=torch.float32,
         )
     t = rec["tls"]
     return _graph.ThreadLocalState(
         grad_enabled=t["grad_enabled"],
-        autocast=_decode(t["autocast"], tensors),
+        autocast=_decode(t["autocast"], tensors) if has_autocast else (),
         autocast_cache_enabled=t["autocast_cache_enabled"],
         default_dtype=_decode(t["default_dtype"], tensors),
     )

@@ -1,6 +1,18 @@
-"""Auxiliary subsystems: checkpoint/resume, profiling, logging/metrics."""
+"""Auxiliary subsystems: checkpoint/resume, failure detection/elastic
+recovery, profiling, logging/metrics."""
 
+from .failures import FailureDetector, device_health, run_elastic
 from .logging import Metrics, get_logger
 from .profiling import StepTimer, Timer, annotate, trace
 
-__all__ = ["Metrics", "get_logger", "StepTimer", "Timer", "annotate", "trace"]
+__all__ = [
+    "FailureDetector",
+    "Metrics",
+    "StepTimer",
+    "Timer",
+    "annotate",
+    "device_health",
+    "get_logger",
+    "run_elastic",
+    "trace",
+]

@@ -27,7 +27,10 @@ def get_logger() -> logging.Logger:
                 logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
             )
             logger.addHandler(h)
-            logger.setLevel(logging.INFO)
+            logger.propagate = False  # avoid double emit via root handlers
+            from .. import config
+
+            logger.setLevel(config.get().log_level)
         _LOGGER = logger
     return _LOGGER
 
