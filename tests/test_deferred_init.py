@@ -461,3 +461,90 @@ class TestThreadLocalState:
 
         t = deferred_init(make)
         assert torch.equal(materialize_tensor(t), torch.full((3,), 2.0))
+
+
+class TestNoDeferredInit:
+    """Public counterpart of the reference's NoDeferredInit guard
+    (deferred_init.h:35-43)."""
+
+    def test_real_tensors_inside_guard(self):
+        from torchdistx_tpu.deferred_init import no_deferred_init
+
+        captured = {}
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+                with no_deferred_init():
+                    table = torch.arange(8.0)  # build-time constant: real
+                captured["table"] = table
+                self.register_buffer("table", table)
+
+        m = deferred_init(M)
+        assert not is_fake(captured["table"])
+        assert is_fake(m.lin.weight)
+        materialize_module(m)
+        assert torch.equal(m.table, torch.arange(8.0))
+
+    def test_session_rng_numbering_survives_guard(self):
+        # A guard in the middle of a recording must not shift the
+        # session-relative key numbering of later ops (jax-bridge RNG).
+        from torchdistx_tpu.deferred_init import no_deferred_init
+        from torchdistx_tpu.jax_bridge import materialize_params_jax
+        import numpy as np
+
+        def make(use_guard):
+            a = torch.empty(8)
+            a.normal_()
+            if use_guard:
+                with no_deferred_init():
+                    torch.ones(3)  # real; consumes nothing recordable
+            b = torch.empty(8)
+            b.normal_()
+            return a, b
+
+        ra, rb = deferred_init(make, False)
+        ga, gb = deferred_init(make, True)
+        ref = materialize_params_jax({"a": ra, "b": rb}, seed=5)
+        got = materialize_params_jax({"a": ga, "b": gb}, seed=5)
+        assert np.array_equal(np.asarray(ref["a"]), np.asarray(got["a"]))
+        assert np.array_equal(np.asarray(ref["b"]), np.asarray(got["b"]))
+
+    def test_guard_outside_recording_is_noop(self):
+        from torchdistx_tpu.deferred_init import no_deferred_init
+
+        with no_deferred_init():
+            t = torch.ones(3)
+        assert not is_fake(t)
+
+    def test_guard_with_foreign_mode_above(self):
+        # The guard must not disturb an unrelated TorchDispatchMode that
+        # is active above the deferred mode (it suspends via a flag, not
+        # by popping torch's LIFO mode stack).
+        from torch.utils._python_dispatch import TorchDispatchMode
+
+        from torchdistx_tpu.deferred_init import (
+            enable_deferred_init,
+            no_deferred_init,
+        )
+
+        seen = {"n": 0}
+
+        class Counter(TorchDispatchMode):
+            def __torch_dispatch__(self, func, types, args=(), kwargs=None):
+                seen["n"] += 1
+                return func(*args, **(kwargs or {}))
+
+        enable_deferred_init(True)
+        try:
+            with Counter():
+                fake_before = torch.ones(2)
+                with no_deferred_init():
+                    real = torch.ones(3)  # foreign mode still sees this
+                fake_after = torch.ones(2)
+        finally:
+            enable_deferred_init(False)
+        assert is_fake(fake_before) and is_fake(fake_after)
+        assert not is_fake(real)
+        assert seen["n"] >= 3  # Counter stayed active throughout

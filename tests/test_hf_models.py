@@ -81,3 +81,41 @@ def test_eager_parity_llama():
     materialize_module(deferred)
     for (n1, p1), (n2, p2) in zip(eager.named_parameters(), deferred.named_parameters()):
         assert torch.equal(p1, p2), n1
+
+
+class TestHFConvenience:
+    """torchdistx_tpu.hf — the from_config wrappers (SURVEY §7)."""
+
+    def test_causal_lm_end_to_end(self):
+        import numpy as np
+        from transformers import GPT2Config
+
+        from torchdistx_tpu.fake import is_fake
+        from torchdistx_tpu.hf import deferred_init_from_config, materialize_sharded
+        from torchdistx_tpu.parallel import make_mesh
+
+        m = deferred_init_from_config(
+            GPT2Config(n_layer=2, n_embd=64, n_head=2, vocab_size=256)
+        )
+        assert all(is_fake(p) for p in m.parameters())
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        params = materialize_sharded(m, mesh, seed=0, min_shard_size=1024)
+        w = np.asarray(params["transformer.wte.weight"])
+        assert np.isfinite(w).all() and w.std() > 0
+        assert any(
+            not getattr(v.sharding, "is_fully_replicated", True)
+            for v in params.values()
+        )
+
+    def test_seq2seq_auto_cls(self):
+        from transformers import AutoModelForSeq2SeqLM, T5Config
+
+        from torchdistx_tpu.fake import is_fake
+        from torchdistx_tpu.hf import deferred_init_from_config
+
+        m = deferred_init_from_config(
+            T5Config(d_model=32, d_ff=64, num_layers=1, num_heads=2,
+                     vocab_size=128, d_kv=16),
+            auto_cls=AutoModelForSeq2SeqLM,
+        )
+        assert all(is_fake(p) for p in m.parameters())

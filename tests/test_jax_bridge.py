@@ -292,3 +292,58 @@ class TestSyntheticOps:
         m = deferred_init(M)
         p = materialize_module_jax(m, seed=0)
         assert np.allclose(np.asarray(p["lin.weight"]), 2.5)
+
+
+class TestExportedInit:
+    """AOT export: lower the init program cross-platform, serialize,
+    reload, run — no retracing at destination (jax_bridge/export.py)."""
+
+    def test_roundtrip_matches_live_materialization(self, tmp_path):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(8, 16)
+                self.b = nn.Embedding(32, 8)
+
+        m = deferred_init(M)
+        live = materialize_module_jax(m, seed=7)
+
+        m2 = deferred_init(M)
+        p = tmp_path / "init.tdxe"
+        from torchdistx_tpu.jax_bridge import load_exported_init, save_exported_init
+
+        names = save_exported_init(m2, p, platforms=("tpu", "cpu"))
+        run, names2 = load_exported_init(p)
+        assert names == names2
+        outs = run(jax.random.PRNGKey(7))
+        got = dict(zip(names2, outs))
+        for k in live:
+            assert np.array_equal(np.asarray(live[k]), np.asarray(got[k])), k
+
+    def test_bad_file_rejected(self, tmp_path):
+        from torchdistx_tpu.jax_bridge import load_exported_init
+
+        p = tmp_path / "junk.tdxe"
+        p.write_bytes(b"not an export")
+        with pytest.raises(ValueError, match="not a torchdistx_tpu init export"):
+            load_exported_init(p)
+
+    def test_truncated_file_rejected(self, tmp_path):
+        from torchdistx_tpu.jax_bridge import load_exported_init
+
+        p = tmp_path / "trunc.tdxe"
+        p.write_bytes(b"TDXEXP01\x10")  # magic + truncated header length
+        with pytest.raises(ValueError):
+            load_exported_init(p)
+
+    def test_platform_mismatch_rejected(self, tmp_path):
+        from torchdistx_tpu.jax_bridge import load_exported_init, save_exported_init
+
+        def make():
+            return torch.ones(3)
+
+        t = deferred_init(make)
+        p = tmp_path / "tpu_only.tdxe"
+        save_exported_init({"t": t}, p, platforms=("tpu",))
+        with pytest.raises(ValueError, match="exported for platforms"):
+            load_exported_init(p)  # current backend is cpu

@@ -42,6 +42,7 @@ __all__ = [
     "materialize_tensor",
     "materialize_module",
     "enable_deferred_init",
+    "no_deferred_init",
     "ReplayTarget",
 ]
 
@@ -76,6 +77,14 @@ class DeferredInitMode(TorchDispatchMode):
 
     def __torch_dispatch__(self, func, types, args=(), kwargs=None):
         kwargs = kwargs or {}
+
+        if getattr(_tls, "suspended", False):
+            # no_deferred_init() guard: behave as if the mode were not
+            # installed — run the op for real (the mode is popped during
+            # its own dispatch, so this does not recurse).  Ops on fake
+            # args still route through the subclass fake dispatch, just
+            # unrecorded — the reference's key-exclusion semantics.
+            return func(*args, **kwargs)
 
         if _is_terminal(func) and any(is_fake(t) for t in _iter_tensors((args, kwargs))):
             # Early replay: materialize fake args (retaining their context
@@ -113,6 +122,32 @@ _deferred_toggle = ModeToggle(
 def enable_deferred_init(enabled: bool) -> None:
     """Re-entrant toggle (enableDeferredInit, deferred_init.cc:1140-1160)."""
     _deferred_toggle.set(enabled)
+
+
+@contextlib.contextmanager
+def no_deferred_init() -> Iterator[None]:
+    """Run the body with deferred-init recording suspended — the public
+    counterpart of the reference's ``NoDeferredInit`` guard
+    (deferred_init.h:35-43, used for self-exclusion at deferred_init.cc:774).
+
+    Inside the guard, factory calls allocate *real* tensors (useful for
+    lookup tables or constants a module constructor genuinely needs at
+    build time).  Ops on existing fake arguments still produce fakes —
+    the per-tensor fake dispatch stays active, as with the reference's
+    key-exclusion — they are just not recorded.  The recording session
+    (and its RNG key numbering) resumes untouched when the guard exits.
+
+    Implemented as a thread-local suspension flag the mode checks, NOT by
+    popping dispatch modes: torch's mode stack pops strictly LIFO with no
+    identity check, so stack surgery would corrupt any unrelated
+    TorchDispatchMode active above the deferred mode.
+    """
+    prev = getattr(_tls, "suspended", False)
+    _tls.suspended = True
+    try:
+        yield
+    finally:
+        _tls.suspended = prev
 
 
 @contextlib.contextmanager

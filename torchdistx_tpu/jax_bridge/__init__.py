@@ -1,6 +1,7 @@
 """JAX bridge: recorded torch init graphs → XLA programs with sharded outputs."""
 
 from .compile import build_init_fn
+from .export import export_init, load_exported_init, save_exported_init
 from .materialize import (
     materialize_module_jax,
     materialize_params_jax,
@@ -10,6 +11,9 @@ from .materialize import (
 
 __all__ = [
     "build_init_fn",
+    "export_init",
+    "load_exported_init",
+    "save_exported_init",
     "materialize_module_jax",
     "materialize_params_jax",
     "materialize_tensor_jax",

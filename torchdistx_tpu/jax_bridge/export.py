@@ -1,0 +1,113 @@
+"""AOT export of init programs (jax.export / StableHLO).
+
+A capability the recording design makes natural and the reference cannot
+offer: on a host with **no accelerator at all**, lower a model's entire
+deferred-init computation for TPU and ship the serialized program; the
+pod side deserializes and runs it without retracing or recompiling from
+Python (`jax.export` embeds the StableHLO + calling convention).
+
+    # login host (CPU-only)
+    model = deferred_init(LlamaForCausalLM, cfg)
+    save_exported_init(model, "llama_init.tdxe", platforms=("tpu", "cpu"))
+
+    # pod
+    run, names = load_exported_init("llama_init.tdxe")
+    params = dict(zip(names, run(jax.random.PRNGKey(0))))
+
+Complements :mod:`torchdistx_tpu.serialize` (which ships the *recording*
+— retraced and compiled at destination, sharding-flexible): the export
+ships the *compiled program* — zero destination compile, fixed layout.
+Exports are single-device programs; shard after load (``jax.device_put``
+with a ``NamedSharding``) or use ``materialize_params_jax`` on a live
+mesh when materialize-time sharding is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Callable, Dict, List, Sequence, Tuple, Union
+
+import jax
+import torch
+
+from ..fake import is_fake
+from .compile import build_init_fn
+
+__all__ = ["export_init", "save_exported_init", "load_exported_init"]
+
+_MAGIC = b"TDXEXP01"
+
+
+def _named_fakes(obj) -> Dict[str, torch.Tensor]:
+    if isinstance(obj, torch.nn.Module):
+        from .materialize import named_fake_tensors
+
+        return named_fake_tensors(obj)
+    bad = [k for k, v in obj.items() if not is_fake(v)]
+    if bad:
+        raise ValueError(f"Entries are not fake tensors: {bad}")
+    return dict(obj)
+
+
+def export_init(
+    obj: Union[torch.nn.Module, Dict[str, torch.Tensor]],
+    *,
+    platforms: Sequence[str] = ("tpu", "cpu"),
+) -> Tuple[bytes, List[str]]:
+    """Lower the init program of ``obj``'s fakes for ``platforms`` and
+    serialize it.  Returns ``(payload, names)`` where calling the
+    deserialized program with a PRNG key yields the values of ``names``
+    in order."""
+    from jax import export as jax_export
+
+    fakes = _named_fakes(obj)
+    names = list(fakes)
+    init_fn = build_init_fn([fakes[n] for n in names])
+    exp = jax_export.export(jax.jit(init_fn), platforms=list(platforms))(
+        jax.random.PRNGKey(0)
+    )
+    blob = exp.serialize()
+    header = json.dumps({"names": names, "platforms": list(platforms)}).encode()
+    return _MAGIC + struct.pack("<I", len(header)) + header + blob, names
+
+
+def save_exported_init(obj, path, *, platforms: Sequence[str] = ("tpu", "cpu")) -> List[str]:
+    payload, names = export_init(obj, platforms=platforms)
+    with open(path, "wb") as f:
+        f.write(payload)
+    return names
+
+
+def load_exported_init(path) -> Tuple[Callable[..., Tuple[jax.Array, ...]], List[str]]:
+    """Load a saved export: ``(run, names)`` with ``run(key) -> tuple`` of
+    arrays matching ``names``.  Executes on the current default platform
+    (must be one the program was exported for)."""
+    from jax import export as jax_export
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:8] != _MAGIC:
+        raise ValueError(f"`{path}` is not a torchdistx_tpu init export.")
+    try:
+        (hlen,) = struct.unpack("<I", data[8:12])
+        if 12 + hlen > len(data):
+            raise ValueError("truncated header")
+        header = json.loads(data[12 : 12 + hlen].decode())
+        names = header["names"]
+        platforms = header.get("platforms", [])
+    except ValueError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            f"`{path}` is a corrupt torchdistx_tpu init export: {e}"
+        ) from e
+    backend = jax.default_backend()
+    if platforms and backend not in platforms:
+        raise ValueError(
+            f"`{path}` was exported for platforms {tuple(platforms)}; the "
+            f"current default backend is {backend!r}. Re-export with "
+            f"platforms=(..., {backend!r}) or run on a matching device."
+        )
+    exp = jax_export.deserialize(data[12 + hlen :])
+    return exp.call, names
