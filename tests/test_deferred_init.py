@@ -548,3 +548,27 @@ class TestNoDeferredInit:
         assert is_fake(fake_before) and is_fake(fake_after)
         assert not is_fake(real)
         assert seen["n"] >= 3  # Counter stayed active throughout
+
+
+class TestDeepcopy:
+    def test_deepcopy_inside_region_records(self):
+        import copy
+
+        def make():
+            lin = nn.Linear(4, 4)
+            twin = copy.deepcopy(lin)
+            twin.weight.data.mul_(2.0)
+            return lin, twin
+
+        lin, twin = deferred_init(make)
+        materialize_module(lin)
+        materialize_module(twin)
+        assert torch.equal(twin.weight, lin.weight * 2.0)
+        assert isinstance(twin.weight, nn.Parameter)
+
+    def test_deepcopy_outside_region_raises_actionably(self):
+        import copy
+
+        m = deferred_init(nn.Linear, 4, 4)
+        with pytest.raises(RuntimeError, match="outside its\n?.*deferred-init region|deferred-init region"):
+            copy.deepcopy(m)

@@ -1,0 +1,71 @@
+"""Breadth coverage: deferred_init → materialize parity across the
+torch.nn module zoo (the reference supports arbitrary modules through
+dispatch-level replay — docs/src/fake_tensor.rst's Blenderbot claim;
+here that property is pinned by test instead of prose)."""
+
+import pytest
+import torch
+import torch.nn as nn
+
+from torchdistx_tpu.deferred_init import deferred_init, materialize_module
+from torchdistx_tpu.fake import is_fake
+
+ZOO = [
+    ("linear", lambda: nn.Linear(8, 4)),
+    ("bilinear", lambda: nn.Bilinear(4, 5, 6)),
+    ("conv1d", lambda: nn.Conv1d(3, 8, 3)),
+    ("conv2d", lambda: nn.Conv2d(3, 8, 3, padding=1)),
+    ("conv3d", lambda: nn.Conv3d(2, 4, 3)),
+    ("conv_transpose2d", lambda: nn.ConvTranspose2d(3, 8, 3)),
+    ("embedding", lambda: nn.Embedding(64, 8)),
+    ("embedding_bag", lambda: nn.EmbeddingBag(64, 8)),
+    ("layernorm", lambda: nn.LayerNorm(8)),
+    ("groupnorm", lambda: nn.GroupNorm(2, 8)),
+    ("batchnorm1d", lambda: nn.BatchNorm1d(8)),
+    ("batchnorm2d", lambda: nn.BatchNorm2d(8)),
+    ("instancenorm2d", lambda: nn.InstanceNorm2d(8, affine=True)),
+    ("rmsnorm", lambda: nn.RMSNorm(8)),
+    ("prelu", lambda: nn.PReLU(8)),
+    ("gru", lambda: nn.GRU(8, 16, num_layers=2)),
+    ("lstm", lambda: nn.LSTM(8, 16, num_layers=2, bidirectional=True)),
+    ("rnn", lambda: nn.RNN(8, 16)),
+    ("mha", lambda: nn.MultiheadAttention(16, 4, kdim=8, vdim=8)),
+    ("transformer", lambda: nn.Transformer(
+        d_model=16, nhead=2, num_encoder_layers=1, num_decoder_layers=1,
+        dim_feedforward=32, batch_first=True)),
+    ("adaptive_softmax", lambda: nn.AdaptiveLogSoftmaxWithLoss(
+        16, 100, cutoffs=[10, 50])),
+    ("sequential_mixed", lambda: nn.Sequential(
+        nn.Conv2d(3, 4, 3), nn.BatchNorm2d(4), nn.ReLU(),
+        nn.Flatten(), nn.LazyLinear(7))),
+]
+
+
+@pytest.mark.parametrize("name,ctor", ZOO, ids=[n for n, _ in ZOO])
+def test_eager_parity(name, ctor):
+    if name == "sequential_mixed":
+        pytest.skip("LazyLinear materializes on first forward, not init")
+    torch.manual_seed(99)
+    eager = ctor()
+    torch.manual_seed(99)
+    d = deferred_init(ctor)
+    assert any(is_fake(p) for p in d.parameters()) or not list(d.parameters())
+    materialize_module(d)
+    eager_state = eager.state_dict()
+    got_state = d.state_dict()
+    assert list(eager_state) == list(got_state)
+    for k in eager_state:
+        assert torch.equal(eager_state[k], got_state[k]), f"{name}.{k}"
+
+
+def test_forward_after_materialize():
+    # A deeper end-to-end: materialized modules actually run.
+    d = deferred_init(
+        lambda: nn.Sequential(nn.Conv2d(3, 8, 3, padding=1),
+                              nn.BatchNorm2d(8), nn.ReLU(),
+                              nn.Conv2d(8, 2, 1))
+    )
+    materialize_module(d)
+    y = d(torch.randn(2, 3, 16, 16))
+    assert y.shape == (2, 2, 16, 16)
+    assert torch.isfinite(y).all()

@@ -35,7 +35,7 @@ from torch.utils._python_dispatch import TorchDispatchMode
 
 from . import _graph
 from ._graph import CONTEXT_KEY, ReplayTarget, record_op
-from .fake import ModeToggle, _fake_handler, _iter_tensors, _tree_map, is_fake
+from .fake import ModeToggle, _fake_handler, _iter_tensors, _tree_map, is_fake, is_param_like
 
 __all__ = [
     "deferred_init",
@@ -192,7 +192,7 @@ def materialize_tensor(
     # Preserve the Python class: Parameter in, Parameter out (the
     # reference's pybind layer rebuilds the original Python type,
     # _C/deferred_init.cc:31-86).
-    if isinstance(tensor, Parameter) or getattr(tensor, "_is_param", False):
+    if is_param_like(tensor):
         real = Parameter(real, requires_grad=tensor.requires_grad)
     return real
 

@@ -197,6 +197,36 @@ class FakeTensor(torch.Tensor):
             "tensors have no storage. Materialize it first."
         )
 
+    def __deepcopy__(self, memo):
+        # copy.deepcopy of a fake (nn.Transformer deepcopies its layer
+        # stack at construction) must NOT walk __dict__: the deferred-init
+        # context chain reaches the whole replay graph and the ctypes
+        # native-engine handle.  Eager deepcopy semantics are a recorded
+        # detach+clone — a new fake computing the same value, sharing the
+        # recording.
+        if id(self) in memo:
+            return memo[id(self)]
+        from . import _graph
+
+        src_ctx = get_fake_context(self, _graph.CONTEXT_KEY)
+        out = self.detach().clone()
+        if src_ctx is not None and get_fake_context(out, _graph.CONTEXT_KEY) is None:
+            # Outside the recording region the clone cannot be recorded —
+            # fail HERE with the real cause instead of handing back a copy
+            # that only breaks later at materialize time.
+            raise RuntimeError(
+                "Cannot deepcopy a recorded fake tensor outside its "
+                "deferred-init region: the copy would be unmaterializable. "
+                "Materialize the module first, or deepcopy inside the "
+                "region (under deferred_init / enable_deferred_init)."
+            )
+        if self.requires_grad:
+            out.requires_grad_(True)
+        if is_param_like(self):
+            out = torch.nn.Parameter(out, requires_grad=self.requires_grad)
+        memo[id(self)] = out
+        return out
+
     # -- dispatch --------------------------------------------------------
 
     @classmethod
@@ -226,6 +256,16 @@ class FakeTensor(torch.Tensor):
 def is_fake(tensor: torch.Tensor) -> bool:
     """``True`` if ``tensor`` is fake (reference fake.py:53-55, fake.cc:621-627)."""
     return isinstance(tensor, FakeTensor)
+
+
+def is_param_like(tensor: torch.Tensor) -> bool:
+    """Parameter-ness of a (possibly fake) tensor: a real ``nn.Parameter``
+    or a fake carrying the ``_is_param`` mark (set when ``nn.Parameter``
+    construction is intercepted, and by serialize's manifest).  The single
+    predicate shared by deepcopy, materialization, and deserialization."""
+    return isinstance(tensor, torch.nn.Parameter) or bool(
+        getattr(tensor, "_is_param", False)
+    )
 
 
 # Installed by _graph at import time: records `fake.data = x` as a

@@ -36,7 +36,7 @@ import torch
 
 from . import _graph
 from ._graph import CONTEXT_KEY, DeferredInitContext, Op, OpNode, _Dep
-from .fake import FakeTensor, get_fake_context, is_fake, set_fake_context
+from .fake import FakeTensor, get_fake_context, is_fake, is_param_like, set_fake_context
 
 __all__ = ["save_recording", "load_recording"]
 
@@ -275,8 +275,7 @@ def save_recording(obj: Union[torch.nn.Module, Dict[str, torch.Tensor]], path) -
             "dtype": _encode_leaf(f.dtype, tensors),
             "device": str(f._fake_device),
             "requires_grad": bool(f.requires_grad),
-            "is_param": isinstance(f, torch.nn.Parameter)
-            or bool(getattr(f, "_is_param", False)),
+            "is_param": is_param_like(f),
         }
 
     torch.save(
