@@ -572,3 +572,49 @@ class TestDeepcopy:
         m = deferred_init(nn.Linear, 4, 4)
         with pytest.raises(RuntimeError, match="outside its\n?.*deferred-init region|deferred-init region"):
             copy.deepcopy(m)
+
+    def test_deepcopy_preserves_view_storage_sharing(self):
+        import copy
+
+        def make():
+            t = torch.zeros(6)
+            d = copy.deepcopy({"a": t, "b": t[:2]})
+            d["a"].fill_(3.0)  # must be visible through the copied view
+            return d["a"], d["b"], t
+
+        a, b, t = deferred_init(make)
+        ra = materialize_tensor(a)
+        rb = materialize_tensor(b)
+        rt = materialize_tensor(t)
+        assert torch.equal(ra, torch.full((6,), 3.0))
+        assert torch.equal(rb, torch.full((2,), 3.0))  # shared in the copy
+        assert torch.equal(rt, torch.zeros(6))  # original untouched
+
+    def test_rng_inside_guard_stays_stream_aligned(self):
+        # A real draw inside no_deferred_init() must consume the
+        # generator AFTER all pending recorded draws (eager order).
+        from torchdistx_tpu.deferred_init import no_deferred_init
+
+        def build(use_region):
+            if use_region:
+                lin = deferred_init(nn.Linear, 8, 8)
+                # guard draw happens mid-session
+                # (deferred_init already exited; emulate in-region)
+                return lin
+            return nn.Linear(8, 8)
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(8, 8, bias=False)
+                with no_deferred_init():
+                    self.r = torch.randn(4)
+
+        torch.manual_seed(21)
+        eager_lin = nn.Linear(8, 8, bias=False)
+        eager_r = torch.randn(4)
+        torch.manual_seed(21)
+        d = deferred_init(M)
+        materialize_module(d)
+        assert torch.equal(d.r, eager_r)
+        assert torch.equal(d.lin.weight, eager_lin.weight)

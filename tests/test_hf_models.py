@@ -24,8 +24,37 @@ def _cases():
         T5ForConditionalGeneration,
     )
 
+    from transformers import (
+        BertConfig,
+        BertModel,
+        ViTConfig,
+        ViTModel,
+        WhisperConfig,
+        WhisperModel,
+    )
+
     return {
         "gpt2": (GPT2LMHeadModel, GPT2Config(n_layer=2, n_embd=64, n_head=4, vocab_size=256)),
+        "bert": (
+            BertModel,
+            BertConfig(hidden_size=64, num_hidden_layers=2, num_attention_heads=2,
+                       intermediate_size=128, vocab_size=256),
+        ),
+        "vit": (  # trunc_normal_ rejection sampling: pins the RNG-order
+            # alignment of control-flow-forced early materialization
+            ViTModel,
+            ViTConfig(hidden_size=64, num_hidden_layers=2, num_attention_heads=2,
+                      intermediate_size=128, image_size=32, patch_size=8),
+        ),
+        "whisper": (
+            WhisperModel,
+            WhisperConfig(d_model=64, encoder_layers=2, decoder_layers=2,
+                          encoder_attention_heads=2, decoder_attention_heads=2,
+                          encoder_ffn_dim=128, decoder_ffn_dim=128, vocab_size=256,
+                          pad_token_id=0, bos_token_id=1, eos_token_id=2,
+                          decoder_start_token_id=1, max_source_positions=64,
+                          max_target_positions=64),
+        ),
         "llama": (
             LlamaForCausalLM,
             LlamaConfig(
@@ -81,6 +110,34 @@ def test_eager_parity_llama():
     materialize_module(deferred)
     for (n1, p1), (n2, p2) in zip(eager.named_parameters(), deferred.named_parameters()):
         assert torch.equal(p1, p2), n1
+
+
+@pytest.mark.parametrize("name", ["bert", "vit", "whisper"])
+def test_eager_parity_extra_families(name):
+    # ViT in particular: HF's trunc_normal_ idiom is rejection sampling
+    # with data-dependent loops; parity requires control-flow-forced
+    # early materialization to replay pending RNG draws in recorded
+    # order (_graph.flush_pending_rng).
+    cls, cfg = _cases()[name]
+    torch.manual_seed(5)
+    eager = cls(cfg)
+    torch.manual_seed(5)
+    deferred = deferred_init(cls, cfg)
+    materialize_module(deferred)
+    for (n1, p1), (n2, p2) in zip(
+        eager.state_dict().items(), deferred.state_dict().items(), strict=True
+    ):
+        assert n1 == n2
+        assert torch.equal(p1, p2), n1
+
+
+@pytest.mark.parametrize("name", ["bert", "vit", "whisper"])
+def test_extra_families_jax_materialize(name):
+    cls, cfg = _cases()[name]
+    m = deferred_init(cls, cfg)
+    params = materialize_module_jax(m, seed=0)
+    for k, v in params.items():
+        assert np.isfinite(np.asarray(v)).all(), k
 
 
 class TestHFConvenience:

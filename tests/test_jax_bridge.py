@@ -347,3 +347,24 @@ class TestExportedInit:
         save_exported_init({"t": t}, p, platforms=("tpu",))
         with pytest.raises(ValueError, match="exported for platforms"):
             load_exported_init(p)  # current backend is cpu
+
+
+class TestDeepcopyLowering:
+    def test_deepcopied_module_lowers(self):
+        # FakeTensor.__deepcopy__ emits as_strided views over a storage
+        # clone; the bridge's as_strided gather/scatter lowering must
+        # reproduce the torch replay values.
+        import copy
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(8, 8, bias=False)
+                self.twin = copy.deepcopy(self.a)
+                self.twin.weight.data.mul_(0.5)
+
+        m = deferred_init(M)
+        p = materialize_module_jax(m, seed=0)
+        assert np.allclose(
+            np.asarray(p["twin.weight"]), np.asarray(p["a.weight"]) * 0.5
+        )

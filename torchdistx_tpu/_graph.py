@@ -76,10 +76,67 @@ _session_tls = threading.local()
 
 def begin_recording_session() -> None:
     _session_tls.counter = itertools.count()
+    _session_tls.rng_nodes = []
 
 
 def end_recording_session() -> None:
     _session_tls.counter = None
+    _session_tls.rng_nodes = []
+
+
+# Ops that consume the torch global generator at replay.  Tracked per
+# session so control-flow-forced early materialization can replay every
+# pending draw in chronological order first — keeping the generator
+# stream aligned with eager execution (see flush_pending_rng).
+_RNG_OP_NAMES = {
+    "aten::uniform_", "aten::normal_", "aten::normal", "aten::bernoulli",
+    "aten::bernoulli_", "aten::rand", "aten::randn", "aten::randint",
+    "aten::randint_", "aten::random_", "aten::randperm",
+    "aten::exponential_", "aten::cauchy_", "aten::log_normal_",
+    "aten::geometric_", "aten::multinomial", "aten::poisson",
+    "aten::rrelu_with_noise", "aten::rand_like", "aten::randn_like",
+    "aten::randint_like",
+}
+
+
+def _is_rng_op(func) -> bool:
+    schema = getattr(func, "_schema", None)
+    return schema is not None and schema.name in _RNG_OP_NAMES
+
+
+def flush_pending_rng(target: Optional["ReplayTarget"] = None) -> None:
+    """Replay every not-yet-materialized RNG-consuming node of the current
+    recording session, in global chronological order.
+
+    Called before any control-flow-forced early materialization
+    (terminal ops, ``bool(fake)``).  Rationale: recording consumes no RNG,
+    so at any point during recording, *eager* execution would have drawn
+    every random op recorded so far, in recorded order.  Early-replaying
+    only the needed chain draws those ops out of order (totals match,
+    positions do not — observed as HF ViT's trunc_normal_ rejection
+    sampling desyncing later weights); replaying all pending draws first
+    keeps the generator stream bit-aligned with eager.
+    """
+    pending = [
+        n for n in (ref() for ref in getattr(_session_tls, "rng_nodes", []))
+        if n is not None and not n.materialized
+    ]
+    if not pending:
+        return
+    target = target or ReplayTarget()
+    todo: List[OpNode] = []
+    seen: Set[int] = set()
+    for n in pending:
+        for m in n.build_call_stack():
+            if id(m) not in seen:
+                seen.add(id(m))
+                todo.append(m)
+    for m in sorted(todo, key=lambda n: n.op_nr):
+        replay_node(m, target)
+    # Cleared only after every replay succeeded: a partial failure (e.g.
+    # the modified-external-arg check) that constructor code catches must
+    # keep the unmaterialized remainder tracked for the next flush.
+    _session_tls.rng_nodes = []
 
 
 def _next_key_nr(op_nr: int) -> int:
@@ -503,6 +560,11 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
     node.dependencies = dependencies
     for dep, _ in dependencies:
         dep.dependents.add(node)
+
+    if _is_rng_op(func):
+        rng_list = getattr(_session_tls, "rng_nodes", None)
+        if rng_list is not None:
+            rng_list.append(weakref.ref(node))
 
     # Version counters of external (real) tensor args
     # (deferred_init.cc:391, 477-486).

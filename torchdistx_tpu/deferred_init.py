@@ -84,11 +84,21 @@ class DeferredInitMode(TorchDispatchMode):
             # its own dispatch, so this does not recurse).  Ops on fake
             # args still route through the subclass fake dispatch, just
             # unrecorded — the reference's key-exclusion semantics.
+            # A real RNG draw here consumes the global generator NOW, so
+            # pending recorded draws must replay first to keep the stream
+            # aligned with eager execution order.
+            if _graph._is_rng_op(func):
+                _graph.flush_pending_rng()
             return func(*args, **kwargs)
 
         if _is_terminal(func) and any(is_fake(t) for t in _iter_tensors((args, kwargs))):
             # Early replay: materialize fake args (retaining their context
-            # so later ops can still extend the recording) and run for real.
+            # so later ops can still extend the recording) and run for
+            # real.  All pending RNG draws replay first, in recorded
+            # order, so the generator stream stays aligned with eager
+            # (_graph.flush_pending_rng).
+            _graph.flush_pending_rng()
+
             def mat(t):
                 if is_fake(t):
                     return _graph.materialize(t, retain_context=True)

@@ -189,8 +189,11 @@ class FakeTensor(torch.Tensor):
         if get_fake_context(self, _graph.CONTEXT_KEY) is not None:
             # Replay must run on real tensors: pop the recording/fake modes
             # (inside __torch_dispatch__ the mode stack is popped for us;
-            # __bool__ is plain Python, so pop it explicitly).
+            # __bool__ is plain Python, so pop it explicitly).  Pending
+            # RNG draws replay first, in recorded order, keeping the
+            # generator stream aligned with eager (flush_pending_rng).
             with torch.utils._python_dispatch._disable_current_modes():
+                _graph.flush_pending_rng()
                 return bool(_graph.materialize(self, retain_context=True))
         raise RuntimeError(
             "The truth value of a fake tensor cannot be determined: fake "
@@ -209,7 +212,20 @@ class FakeTensor(torch.Tensor):
         from . import _graph
 
         src_ctx = get_fake_context(self, _graph.CONTEXT_KEY)
-        out = self.detach().clone()
+        # Eager torch deepcopy copies the underlying STORAGE once per
+        # memo, so views inside the copied structure keep sharing it.
+        # Mirror that with recorded ops: clone a full-extent alias of the
+        # storage (once, memoized by storage), then re-view.
+        meta = self._meta
+        skey = ("tdx_fake_storage", meta.untyped_storage()._cdata, self.dtype)
+        full_copy = memo.get(skey)
+        if full_copy is None:
+            n = meta.untyped_storage().nbytes() // meta.element_size()
+            full_copy = self.detach().as_strided((n,), (1,), 0).clone()
+            memo[skey] = full_copy
+        out = full_copy.as_strided(
+            tuple(self.shape), tuple(self.stride()), self.storage_offset()
+        )
         if src_ctx is not None and get_fake_context(out, _graph.CONTEXT_KEY) is None:
             # Outside the recording region the clone cannot be recorded —
             # fail HERE with the real cause instead of handing back a copy

@@ -465,6 +465,34 @@ def _alias_view(ctx, base_shape, **kw):
     return (lambda b: b), (lambda b, v: v)
 
 
+@_reg("aten.as_strided.default", "view")
+def _as_strided(ctx, base_shape, size, stride, storage_offset=None, **kw):
+    # General strided view as a flat gather (fwd) / scatter (bwd).  Used
+    # by FakeTensor.__deepcopy__'s storage-copy protocol; overlapping
+    # strides write last-wins in bwd, matching in-place-through-view
+    # replay on disjoint views (the only recorded use).
+    size = tuple(int(s) for s in size)
+    stride = tuple(int(s) for s in stride)
+    offset = int(storage_offset or 0)
+
+    def _indices():
+        idx = jnp.asarray(offset, jnp.int32)
+        for dim, (s, st) in enumerate(zip(size, stride)):
+            shape = [1] * len(size)
+            shape[dim] = s
+            idx = idx + (jnp.arange(s, dtype=jnp.int32) * st).reshape(shape)
+        return idx
+
+    def fwd(b):
+        return jnp.ravel(b)[_indices()]
+
+    def bwd(b, v):
+        flat = jnp.ravel(b).at[_indices()].set(v)
+        return flat.reshape(b.shape)
+
+    return fwd, bwd
+
+
 @_reg(["aten.view.default", "aten._unsafe_view.default", "aten.reshape.default"], "view")
 def _view(ctx, base_shape, size, **kw):
     size = tuple(size)
