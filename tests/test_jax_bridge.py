@@ -368,3 +368,32 @@ class TestDeepcopyLowering:
         assert np.allclose(
             np.asarray(p["twin.weight"]), np.asarray(p["a.weight"]) * 0.5
         )
+
+    def test_deepcopy_of_view_first_lowers_correctly(self):
+        # The storage-copy protocol may emit the full-extent as_strided
+        # against a VIEW (when the view is deepcopied before its base);
+        # the lowering must resolve storage-relative, not view-relative.
+        import copy
+
+        def make():
+            t = torch.arange(6.0)
+            d = copy.deepcopy({"b": t[2:4]})
+            return d["b"]
+
+        b = deferred_init(make)
+        arr = materialize_tensor_jax(b)
+        assert np.array_equal(np.asarray(arr), [2.0, 3.0])
+
+    def test_deepcopy_of_noncontiguous_lowers_correctly(self):
+        import copy
+
+        def make():
+            t = torch.arange(6.0).reshape(2, 3)
+            d = copy.deepcopy({"tt": t.t()})
+            return d["tt"]
+
+        tt = deferred_init(make)
+        arr = materialize_tensor_jax(tt)
+        assert np.array_equal(
+            np.asarray(arr), np.arange(6.0).reshape(2, 3).T
+        )

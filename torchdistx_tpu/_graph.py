@@ -81,7 +81,9 @@ def begin_recording_session() -> None:
 
 def end_recording_session() -> None:
     _session_tls.counter = None
-    _session_tls.rng_nodes = []
+    # rng_nodes is deliberately KEPT: value reads after the region
+    # (b.item() on a returned fake) must still replay pending draws in
+    # recorded order.  The list resets at the next session start.
 
 
 # Ops that consume the torch global generator at replay.  Tracked per
@@ -118,8 +120,8 @@ def flush_pending_rng(target: Optional["ReplayTarget"] = None) -> None:
     keeps the generator stream bit-aligned with eager.
     """
     pending = [
-        n for n in (ref() for ref in getattr(_session_tls, "rng_nodes", []))
-        if n is not None and not n.materialized
+        n for n in getattr(_session_tls, "rng_nodes", [])
+        if not n.materialized
     ]
     if not pending:
         return
@@ -564,7 +566,10 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
     if _is_rng_op(func):
         rng_list = getattr(_session_tls, "rng_nodes", None)
         if rng_list is not None:
-            rng_list.append(weakref.ref(node))
+            # Strong refs: a draw whose fake died before the flush still
+            # consumed an eager stream position and must replay on time.
+            # Bounded by the session; cleared on flush / next session.
+            rng_list.append(node)
 
     # Version counters of external (real) tensor args
     # (deferred_init.cc:391, 477-486).

@@ -178,27 +178,48 @@ class FakeTensor(torch.Tensor):
                 f"device='{self._fake_device}', fake=True)"
             )
 
-    def __bool__(self):
-        # Value-dependent control flow on a *recorded* fake materializes it
-        # early (same protocol as the terminal ops aten::item /
-        # aten::is_nonzero, deferred_init.cc:792-797) — torch's own init
-        # helpers branch on tensor predicates (e.g. `if not mask.any()` in
-        # nn.init.trunc_normal_). A bare fake-mode fake still raises.
+    def _early_value(self, what: str) -> torch.Tensor:
+        """Value-dependent reads on a *recorded* fake materialize it early
+        (the terminal-op protocol, deferred_init.cc:792-797) — torch's own
+        init helpers branch on tensor predicates (`if not mask.any()` in
+        nn.init.trunc_normal_).  A bare fake-mode fake still raises.
+
+        Replay must run on real tensors, so the recording/fake modes are
+        popped (inside __torch_dispatch__ that happens automatically;
+        these are plain-Python entry points), and pending RNG draws
+        replay first in recorded order (flush_pending_rng)."""
         from . import _graph
 
-        if get_fake_context(self, _graph.CONTEXT_KEY) is not None:
-            # Replay must run on real tensors: pop the recording/fake modes
-            # (inside __torch_dispatch__ the mode stack is popped for us;
-            # __bool__ is plain Python, so pop it explicitly).  Pending
-            # RNG draws replay first, in recorded order, keeping the
-            # generator stream aligned with eager (flush_pending_rng).
-            with torch.utils._python_dispatch._disable_current_modes():
-                _graph.flush_pending_rng()
-                return bool(_graph.materialize(self, retain_context=True))
-        raise RuntimeError(
-            "The truth value of a fake tensor cannot be determined: fake "
-            "tensors have no storage. Materialize it first."
-        )
+        if get_fake_context(self, _graph.CONTEXT_KEY) is None:
+            raise RuntimeError(
+                f"{what} of a fake tensor cannot be read: fake tensors "
+                f"have no storage. Materialize it first."
+            )
+        with torch.utils._python_dispatch._disable_current_modes():
+            _graph.flush_pending_rng()
+            return _graph.materialize(self, retain_context=True)
+
+    def __bool__(self):
+        return bool(self._early_value("The truth value"))
+
+    def item(self):
+        return self._early_value("The value").item()
+
+    def tolist(self):
+        # The reference documents tolist()/numpy() as unsupported failure
+        # patterns (docs/src/deferred_init.rst:204-207); the early-replay
+        # hatch covers them here.  Snapshot semantics: the result holds
+        # the value at call time (eager `numpy()` would alias storage).
+        return self._early_value("The value").tolist()
+
+    def numpy(self, *, force: bool = False):
+        return self._early_value("The value").numpy(force=force).copy()
+
+    def __float__(self):
+        return float(self._early_value("The value"))
+
+    def __int__(self):
+        return int(self._early_value("The value"))
 
     def __deepcopy__(self, memo):
         # copy.deepcopy of a fake (nn.Transformer deepcopies its layer

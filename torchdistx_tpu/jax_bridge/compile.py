@@ -225,6 +225,13 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         env[(id(node), 0)] = box
     elif kind == "view":
         box = _first_dep_box(args, env, node.dependencies)
+        if name == "aten.as_strided.default":
+            # as_strided is STORAGE-relative, not view-relative: resolve
+            # to the root box, whose value is the factory allocation that
+            # spans the storage contiguously (a view's logical value does
+            # not — gathering against it returns scrambled values).
+            while isinstance(box, ViewBox):
+                box = box.base
         rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
         kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
         base_shape = tuple(box.read().shape)
