@@ -112,25 +112,56 @@ std::vector<uint64_t> build_call_stack(Graph& g, Node& node) {
     std::vector<Node*> snapshot;
     snapshot.reserve(included.size());
     for (auto& [id, n] : included) snapshot.push_back(n);
-    for (Node* n : snapshot) {
-      for (uint64_t d_id : n->dependents) {
+    // The alias FRONTIER: included nodes plus the transitive dependency
+    // closure over them.  Materialized nodes are never replayed, but
+    // their cached outputs carry the aliasing relation — view chains and
+    // readers hanging off them are otherwise unreachable (mirrors the
+    // Python walk; found by the replay fuzzer's data-ops suite).
+    std::vector<Node*> frontier(snapshot);
+    std::unordered_set<uint64_t> fseen;
+    for (Node* f : frontier) fseen.insert(f->id);
+    for (size_t fi = 0; fi < frontier.size(); ++fi) {
+      for (auto& [dep_id, idx] : frontier[fi]->deps) {
+        Node* dep = g.get(dep_id);
+        if (dep && !fseen.count(dep->id)) {
+          fseen.insert(dep->id);
+          frontier.push_back(dep);
+        }
+      }
+    }
+    // (a) aliasing dependents of any frontier node, up to the last
+    // in-place node.
+    for (Node* f : frontier) {
+      for (uint64_t d_id : f->dependents) {
         Node* d = g.get(d_id);
         if (!d || included.count(d->id) || d->materialized) continue;
-        if (d->op_nr <= last->op_nr && storages_intersect(*d, *n)) {
+        if (d->op_nr <= last->op_nr && storages_intersect(*d, *f)) {
           visit(d);
           changed = true;
         }
       }
-      for (auto& [dep_id, idx] : n->deps) {
-        Node* dep = g.get(dep_id);
-        if (!dep || !included.count(dep_id)) continue;
-        if (!storages_intersect(*n, *dep)) continue;  // not in-place on dep
-        for (uint64_t r_id : dep->dependents) {
-          Node* r = g.get(r_id);
-          if (!r || included.count(r_id) || r->materialized) continue;
-          if (r->op_nr < n->op_nr && !storages_intersect(*r, *dep)) {
-            visit(r);
-            changed = true;
+    }
+    // (b) readers clobbered by a later included mutation of a storage an
+    // earlier frontier node aliases.  Indexed by storage key so the scan
+    // touches only genuinely aliasing (n, v) pairs.
+    std::unordered_map<uint64_t, std::vector<Node*>> carriers_by_storage;
+    for (Node* v : frontier)
+      for (uint64_t sk : v->storages) carriers_by_storage[sk].push_back(v);
+    for (Node* n : snapshot) {
+      std::unordered_set<uint64_t> seen_v;
+      for (uint64_t sk : n->storages) {
+        auto it = carriers_by_storage.find(sk);
+        if (it == carriers_by_storage.end()) continue;
+        for (Node* v : it->second) {
+          if (v == n || seen_v.count(v->id) || v->op_nr >= n->op_nr) continue;
+          seen_v.insert(v->id);
+          for (uint64_t r_id : v->dependents) {
+            Node* r = g.get(r_id);
+            if (!r || included.count(r_id) || r->materialized) continue;
+            if (r->op_nr < n->op_nr && !storages_intersect(*r, *v)) {
+              visit(r);
+              changed = true;
+            }
           }
         }
       }

@@ -28,7 +28,8 @@ N_PROGRAMS = 25
 N_OPS = 14
 
 
-def _gen_program(rng: random.Random, *, allow_rng_ops: bool):
+def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
+                 allow_data_ops: bool = False):
     """Generate a random op list by trial-running it eagerly.
 
     Returns a list of (kind, payload) steps; `run` interprets them against
@@ -47,6 +48,8 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool):
             ["full", "arange", "view", "inplace_scalar", "inplace_binary",
              "outofplace", "clone"]
             + (["uniform_"] if allow_rng_ops else [])
+            + (["set_data", "data_read", "deepcopy", "value_read"]
+               if allow_data_ops else [])
         )
         try:
             if kind == "full":
@@ -122,6 +125,32 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool):
                 pool[i].uniform_(-1.0, 1.0)
                 steps.append((kind, i))
                 pool.append(pool[i])
+            elif kind == "set_data":
+                i = rng.randrange(len(pool))
+                cands = [
+                    j for j, t in enumerate(pool)
+                    if t.shape == pool[i].shape and t is not pool[i]
+                ]
+                if not cands:
+                    continue
+                j = rng.choice(cands)
+                pool[i].data = pool[j]
+                steps.append((kind, i, j))
+                pool.append(pool[i])
+            elif kind == "data_read":
+                i = rng.randrange(len(pool))
+                emit((kind, i), pool[i].data)
+            elif kind == "deepcopy":
+                import copy
+
+                i = rng.randrange(len(pool))
+                emit((kind, i), copy.deepcopy(pool[i]))
+            elif kind == "value_read":
+                # Forces early materialization + pending-RNG flush, then
+                # the value feeds back into the recorded program.
+                i = rng.randrange(len(pool))
+                v = float(pool[i].sum())
+                emit((kind, i), torch.full((2, 2), v))
         except Exception:
             # invalid for current shapes/layouts (e.g. flatten on a
             # non-contiguous transpose) — try another op
@@ -165,6 +194,19 @@ def run(steps):
         elif kind == "uniform_":
             pool[step[1]].uniform_(-1.0, 1.0)
             pool.append(pool[step[1]])
+        elif kind == "set_data":
+            _, i, j = step
+            pool[i].data = pool[j]
+            pool.append(pool[i])
+        elif kind == "data_read":
+            pool.append(pool[step[1]].data)
+        elif kind == "deepcopy":
+            import copy
+
+            pool.append(copy.deepcopy(pool[step[1]]))
+        elif kind == "value_read":
+            v = float(pool[step[1]].sum())
+            pool.append(torch.full((2, 2), v))
     return pool
 
 
@@ -223,3 +265,22 @@ def test_jax_bridge_replay_matches_eager(seed):
         assert np.array_equal(
             eager[int(k)].numpy(), np.asarray(arr)
         ), f"seed={seed} pool[{k}] {steps}"
+
+
+@pytest.mark.parametrize("seed", range(2 * N_PROGRAMS, 3 * N_PROGRAMS))
+def test_data_ops_and_value_reads_match_eager(seed):
+    # Adds .data reads/writes, deepcopy (recorded storage clone), and
+    # value reads (early materialization + pending-RNG flush) to the op
+    # pool.  Seeded BEFORE recording: flushes draw at record time, the
+    # remainder at materialize time — the flush mechanism must keep the
+    # combined stream identical to eager.
+    steps = _gen_program(
+        random.Random(seed), allow_rng_ops=True, allow_data_ops=True
+    )
+    torch.manual_seed(777)
+    eager = run(steps)
+    torch.manual_seed(777)
+    fakes = deferred_init(run, steps)
+    reals = _materialize_all(fakes)
+    for k, (a, b) in enumerate(zip(eager, reals)):
+        assert torch.equal(a, b), f"seed={seed} pool[{k}] {steps}"

@@ -458,37 +458,84 @@ class OpNode:
         # chain w → select → add_ must replay even though the final node
         # does not depend on it), up to the last in-place node; (b) readers
         # of a storage that a later included in-place op clobbers (they can
-        # never replay correctly afterwards).
+        # never replay correctly afterwards).  Readers are found through
+        # EVERY included alias of the clobbered storage, not only the
+        # mutator's direct dependency — a reader through a view (e.g. a
+        # `.data` detach) of the mutated base is equally clobbered (found
+        # by the replay fuzzer, tests/test_fuzz_replay.py data-ops suite).
         changed = True
         while changed:
             changed = False
-            for n in list(included.values()):
-                for d in list(n.dependents):
+            nodes_now = list(included.values())
+            # The alias FRONTIER: included nodes plus their (possibly
+            # already materialized) dependencies.  Materialized nodes are
+            # never replayed, but their cached outputs still carry the
+            # aliasing relation — dependents hanging off them (view
+            # chains, readers) are otherwise unreachable from the
+            # included set (found by the replay fuzzer's data-ops suite).
+            frontier = list(nodes_now)
+            fseen = {id(f) for f in frontier}
+            fi = 0
+            while fi < len(frontier):  # transitive dependency closure:
+                # materialized view chains (flatten→full) carry aliasing
+                # through multiple hops the included set never replays.
+                for dep, _ in frontier[fi].dependencies:
+                    if id(dep) not in fseen:
+                        fseen.add(id(dep))
+                        frontier.append(dep)
+                fi += 1
+            for f in frontier:
+                # (a) aliasing dependents of any frontier node replay too
+                # (mutations and views of the same storages), up to the
+                # last in-place node.
+                for d in list(f.dependents):
                     if id(d) in included or d.materialized:
                         continue
-                    if d.op_nr <= last.op_nr and d.storages & n.storages:
+                    if d.op_nr <= last.op_nr and d.storages & f.storages:
                         visit(d)
                         changed = True
-                for dep, _ in n.dependencies:
-                    if id(dep) not in included or not (n.storages & dep.storages):
-                        continue  # n is not an in-place mutation of dep's output
-                    for reader in list(dep.dependents):
-                        if (
-                            id(reader) not in included
-                            and reader.op_nr < n.op_nr
-                            and not reader.materialized
-                            and not (reader.storages & dep.storages)
-                        ):
-                            visit(reader)
-                            changed = True
+            # Storage index over the frontier so the reader scan touches
+            # only genuinely aliasing (n, v) pairs, not the full product.
+            carriers_by_storage: Dict[int, List[OpNode]] = {}
+            for v in frontier:
+                for sk in v.storages:
+                    carriers_by_storage.setdefault(sk, []).append(v)
+            for n in nodes_now:
+                # (b) n mutates a storage an earlier frontier node v
+                # aliases; v's non-aliasing dependents that read before
+                # the mutation are clobbered by it (replaying onto a
+                # materialized v mutates its cached output) and must
+                # replay first.
+                seen_v: Set[int] = set()
+                for sk in n.storages:
+                    for v in carriers_by_storage.get(sk, ()):
+                        if v is n or id(v) in seen_v or v.op_nr >= n.op_nr:
+                            continue
+                        seen_v.add(id(v))
+                        for reader in list(v.dependents):
+                            if (
+                                id(reader) not in included
+                                and reader.op_nr < n.op_nr
+                                and not reader.materialized
+                                and not (reader.storages & v.storages)
+                            ):
+                                visit(reader)
+                                changed = True
         stack = sorted(included.values(), key=lambda n: n.op_nr)
         return stack
 
     def detach_dependencies(self) -> None:
-        # Free graph memory as materialization proceeds
-        # (deferred_init.cc:518-521).
-        self.dependencies = []
+        """Free replay-only memory as materialization proceeds (the
+        reference drops its dependency refs outright,
+        deferred_init.cc:518-521).  The TOPOLOGY stays: later walks still
+        traverse materialized nodes — a mutation recorded after this node
+        materialized must find readers of its cached output through these
+        edges (replay fuzzer).  The heavy payloads go: the preserved
+        argument stack (which may pin big external real tensors) and the
+        version list; a materialized node never replays again."""
         self.argument_versions = []
+        self.op.args = ()
+        self.op.kwargs = {}
 
 
 class DeferredInitContext:
@@ -615,8 +662,14 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
 def _set_data_replay(base: torch.Tensor, value: torch.Tensor) -> torch.Tensor:
     # Replays `base.data = value` on real tensors (reference replay
     # closure for "VariableHooks::set_data", deferred_init.cc:949-971).
-    base.data = value
-    return base
+    # Rebind a FRESH alias, not `base` itself: `base` is the producer
+    # node's cached output, and mutating it would clobber the value for
+    # earlier readers that have not replayed yet (found by the replay
+    # fuzzer).  The returned tensor aliases `value`'s storage, so later
+    # mutations through either side stay shared.
+    out = base.detach()
+    out.data = value
+    return out
 
 
 SYNTHETIC_OPS: Dict[str, Any] = {"tdx::set_data": _set_data_replay}
