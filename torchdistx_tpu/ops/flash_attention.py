@@ -78,7 +78,9 @@ def _fwd_kernel(
     k_ref,  # [1, block_k, D]
     v_ref,  # [1, block_k, D]
     o_ref,  # [1, block_q, D]
-    lse_ref,  # [1, block_q] (2D: minor dim is the full block, lane-aligned)
+    lse_ref,  # [1, block_q, _LANES] (lse broadcast across full lanes, the
+    #           upstream TPU flash layout — a 1-wide minor dim violates
+    #           Mosaic's (8, 128) block tiling rule; ADVICE r1)
     acc_ref,  # VMEM [block_q, D] f32
     m_ref,  # VMEM [block_q, _LANES] f32
     l_ref,  # VMEM [block_q, _LANES] f32
@@ -137,8 +139,8 @@ def _fwd_kernel(
     def _finish():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
-        lse_ref[0] = lse[:, 0]
+        lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+        lse_ref[0] = lse  # all lanes equal; consumers read lane 0
 
 
 # ---------------------------------------------------------------------------
@@ -154,17 +156,20 @@ def _block_p_ds(
 
     p  = exp(q k^T * scale - lse)         [bq, bk]
     ds = p * (do v^T - delta) * scale     (gradient of the raw logits)
+
+    ``lse`` and ``delta`` arrive as [bq, 1] column vectors (lane 0 of the
+    lane-broadcast row carriers).
     """
     s = jax.lax.dot_general(
         q * sm_scale, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     mask = _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal)
-    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [bq, bk]
-    ds = p * (dp - delta[:, None]) * sm_scale
+    ds = p * (dp - delta) * sm_scale
     return p, ds
 
 
@@ -194,10 +199,10 @@ def _bwd_dq_kernel(
         _, ds = _block_p_ds(
             q_ref[0].astype(jnp.float32),
             k_ref[0].astype(jnp.float32),
-            lse_ref[0],
+            lse_ref[0, :, :1],
             do_ref[0].astype(jnp.float32),
             v_ref[0].astype(jnp.float32),
-            delta_ref[0],
+            delta_ref[0, :, :1],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
         )
@@ -249,10 +254,10 @@ def _bwd_dkv_kernel(
         p, ds = _block_p_ds(
             q,
             k_ref[0].astype(jnp.float32),
-            lse_ref[0],
+            lse_ref[0, :, :1],
             do,
             v_ref[0].astype(jnp.float32),
-            delta_ref[0],
+            delta_ref[0, :, :1],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
         )
@@ -309,14 +314,14 @@ def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            # 2D lse with the block as the minor dim: a (1, bq, 1) block
-            # has a 1-wide minor dim, which TPU lowering pads/lays out
-            # degenerately (ADVICE r1); (1, bq) is lane-aligned.
-            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            # lse carried at full lane width (Mosaic requires the minor
+            # block dim be 128-divisible or the whole array dim; a bare
+            # (1, bq) block trips that rule on real TPU — ADVICE r1).
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kj: (bh, qi, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(qp.shape, qh.dtype),
-            jax.ShapeDtypeStruct((BH, qp.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((BH, qp.shape[1], _LANES), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -337,7 +342,12 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qp, dop = _pad_seq(qh, block_q), _pad_seq(do, block_q)
     kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
-    dp, lsep = _pad_seq(delta, block_q), lse  # [BH, Sq] 2D; lse padded by fwd
+    # Row carriers (lse, delta) ride at full lane width like the forward's
+    # lse output (Mosaic block-tiling rule); kernels read lane 0.
+    dp = jnp.broadcast_to(
+        _pad_seq(delta, block_q)[:, :, None], lse.shape
+    )
+    lsep = lse  # [BH, Sq_padded, _LANES], padded by fwd
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
     common = dict(
@@ -345,7 +355,7 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
         block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
     )
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0))
-    rowspec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+    rowspec = pl.BlockSpec((1, block_q, _LANES), lambda bh, i, j: (bh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -371,7 +381,8 @@ def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interp
         (1, block_q, D), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
     )
     rowspec2 = pl.BlockSpec(
-        (1, block_q), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq)
+        (1, block_q, _LANES),
+        lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0),
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, groups=groups, **common),
