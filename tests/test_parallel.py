@@ -337,3 +337,55 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(4)
+
+
+class TestHybridMesh:
+    """make_hybrid_mesh: DCN axes stride across (virtual) slices, ICI axes
+    stay within one — the multi-slice layout where tp/sp collectives must
+    never cross DCN."""
+
+    def test_ici_axes_stay_within_slice(self):
+        from torchdistx_tpu.parallel import make_hybrid_mesh
+
+        devs = jax.devices()
+        mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 2, "tp": 2}, num_slices=2)
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 2, 2)
+        # Virtual slice i == contiguous block i of the device list; every
+        # (fsdp, tp) submesh at fixed dp must be wholly inside one block.
+        for i in range(2):
+            ids = {d.id for d in mesh.devices[i].flat}
+            expected = {d.id for d in devs[i * 4 : (i + 1) * 4]}
+            assert ids == expected
+
+    def test_axis_inference_and_errors(self):
+        from torchdistx_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dp": -1}, {"tp": -1}, num_slices=2)
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "tp": 4}
+        with pytest.raises(ValueError, match="multiply"):
+            make_hybrid_mesh({"dp": 3}, {"tp": 4}, num_slices=2)
+        with pytest.raises(ValueError, match="both"):
+            make_hybrid_mesh({"dp": 2}, {"dp": 4}, num_slices=2)
+        with pytest.raises(ValueError, match="divisible"):
+            make_hybrid_mesh({"dp": -1}, {"tp": -1}, num_slices=3)
+
+    def test_train_step_on_hybrid_mesh(self):
+        from torchdistx_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 2, "tp": 2}, num_slices=2)
+        model = make_llama(TINY)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(0), (8, 16), 0, TINY.vocab_size
+        )
+        fakes = deferred_init(model.init, jax.random.PRNGKey(0), toks)
+        params = materialize(fakes, mesh=mesh, plan=decoder_lm_plan())
+        init_state, step, shard_batch = make_train_step(model, TINY, mesh)
+        state = init_state(params)
+        state, metrics = step(state, shard_batch(toks))
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_initialize_multihost_single_process_noop(self):
+        from torchdistx_tpu.parallel import initialize_multihost
+
+        assert initialize_multihost() == jax.process_index()

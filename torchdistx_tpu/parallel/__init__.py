@@ -1,13 +1,15 @@
 """Parallelism layer: mesh construction, sharding plans, collectives, and
 parallel attention/pipeline/MoE building blocks."""
 
-from .mesh import make_mesh, single_device_mesh
+from .mesh import initialize_multihost, make_hybrid_mesh, make_mesh, single_device_mesh
 from .ring_attention import make_ring_attention
 from .sharding import CallableShardingPlan, ShardingPlan, fsdp_plan
 from .ulysses import make_ulysses_attention
 
 __all__ = [
     "make_mesh",
+    "make_hybrid_mesh",
+    "initialize_multihost",
     "single_device_mesh",
     "ShardingPlan",
     "CallableShardingPlan",

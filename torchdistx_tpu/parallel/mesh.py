@@ -65,3 +65,179 @@ def make_mesh(
 
 def single_device_mesh() -> Mesh:
     return make_mesh({"dp": 1})
+
+
+def _slice_id(device) -> int:
+    """Which pod slice a device belongs to (0 on single-slice/CPU)."""
+    sid = getattr(device, "slice_index", None)
+    if sid is None:
+        return 0
+    return int(sid)
+
+
+def _resolve_axes(group: Dict[str, int], total: int, kind: str):
+    """Resolve one ``{axis: size}`` group against its device budget
+    (at most one ``-1`` size, inferred; sizes must multiply to total)."""
+    names, sizes = list(group), list(group.values())
+    if sizes.count(-1) > 1:
+        raise ValueError(f"At most one {kind} axis size may be -1.")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if total % known:
+            raise ValueError(
+                f"Cannot infer {kind} axis: {total} not divisible by {known}."
+            )
+        sizes = [total // known if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != total:
+        raise ValueError(
+            f"{kind} axes {dict(zip(names, sizes))} must multiply to {total} "
+            f"({'slices' if kind == 'DCN' else 'devices per slice'})."
+        )
+    return names, sizes
+
+
+def _check_disjoint(dcn_names, ici_names) -> None:
+    overlap = set(dcn_names) & set(ici_names)
+    if overlap:
+        raise ValueError(f"Axes {sorted(overlap)} appear in both DCN and ICI groups.")
+
+
+def make_hybrid_mesh(
+    dcn_axes: Dict[str, int],
+    ici_axes: Dict[str, int],
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: Optional[int] = None,
+) -> Mesh:
+    """Create a mesh whose ``dcn_axes`` stride *across* pod slices and whose
+    ``ici_axes`` stay *within* a slice.
+
+    Multi-slice TPU deployments have two interconnects: ICI inside a slice
+    (fast) and DCN between slices (slow).  Collectives over an axis only
+    ride ICI when every device along that axis lives in one slice — this
+    helper arranges the device array so that is true for every ICI axis,
+    the scaling-book layout (dp/fsdp replicas over DCN, tp/sp/ep over ICI).
+    The reference scopes out multi-node entirely (SURVEY.md §2.5: no
+    NCCL/MPI anywhere); this is its TPU-native counterpart.
+
+    Slice membership comes from ``device.slice_index``.  On single-slice or
+    CPU test backends pass ``num_slices`` to carve the device list into
+    equal contiguous *virtual* slices (tests/conftest.py's 8-device CPU
+    mesh → ``num_slices=2`` models a 2-host pod).
+
+    One axis size in each group may be ``-1`` (inferred).  Axis order is
+    DCN axes (outermost, as given) then ICI axes, so the innermost —
+    fastest-varying — axes are intra-slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_slices is not None:
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {num_slices} slices."
+            )
+        per = len(devices) // num_slices
+        slices = [devices[i * per : (i + 1) * per] for i in range(num_slices)]
+    else:
+        by_slice: Dict[int, list] = {}
+        for d in devices:
+            by_slice.setdefault(_slice_id(d), []).append(d)
+        slices = [by_slice[k] for k in sorted(by_slice)]
+        sizes = {len(s) for s in slices}
+        if len(sizes) > 1:
+            raise ValueError(f"Unequal slice sizes: { {k: len(v) for k, v in by_slice.items()} }")
+    n_slices, per_slice = len(slices), len(slices[0])
+    dcn_names, dcn_sizes = _resolve_axes(dcn_axes, n_slices, "DCN")
+    ici_names, ici_sizes = _resolve_axes(ici_axes, per_slice, "ICI")
+    _check_disjoint(dcn_names, ici_names)
+
+    if num_slices is None and n_slices > 1:
+        # Real multi-slice hardware: delegate device arrangement to
+        # mesh_utils.create_hybrid_device_mesh, which lays ICI axes out
+        # torus-aware within each slice (a naive enumeration-order reshape
+        # would not respect the physical topology).  Its two shape args
+        # are elementwise-multiplied per axis; our convention keeps DCN
+        # and ICI axes separate, so pad each group with 1-sized
+        # counterparts for the other's positions.
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[1] * len(dcn_sizes) + list(ici_sizes),
+            dcn_mesh_shape=list(dcn_sizes) + [1] * len(ici_sizes),
+            devices=devices,
+        )
+        return Mesh(arr, axis_names=tuple(dcn_names + ici_names))
+
+    arr = np.array([s for s in slices]).reshape(dcn_sizes + ici_sizes)
+    return Mesh(arr, axis_names=tuple(dcn_names + ici_names))
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Bring up the JAX distributed runtime for a multi-host deployment.
+
+    The TPU-native counterpart of a NCCL/MPI bootstrap (the reference has
+    none, SURVEY.md §2.5): after this, ``jax.devices()`` is the *global*
+    device list and every mesh/collective in this package spans hosts.
+    On TPU pods (and slurm/Open-MPI launchers) all three arguments
+    auto-detect via jax's cluster detection; elsewhere they fall back to
+    the standard env vars (``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``).  Call this FIRST — before
+    any jax API that initializes the XLA backend (``jax.devices()``,
+    ``jax.process_count()``, any computation); jax.distributed refuses to
+    start afterwards.  Idempotent; returns this host's process index.
+    """
+    import os
+
+    # Deliberately no jax.process_count()/default_backend() probes here:
+    # they initialize the XLA backend, after which
+    # jax.distributed.initialize() unconditionally raises.
+    state = getattr(jax._src.distributed, "global_state", None)
+    if getattr(state, "client", None) is not None:
+        return jax.process_index()  # already initialized
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    explicit = (
+        coordinator_address is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or num_processes is not None
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except ValueError:
+        if explicit:
+            raise  # a real misconfiguration, not "nothing to detect"
+        # No explicit config and no detectable cluster (TPU-pod metadata,
+        # slurm, ompi): single-process run, nothing to initialize.
+        return 0
+    except RuntimeError:
+        # The XLA backend was already initialized.  Only benign when this
+        # is genuinely a single-process run; on a detectable cluster the
+        # caller has an ordering bug that must not be swallowed.
+        if explicit or _cluster_detectable():
+            raise
+        return 0
+    return jax.process_index()
+
+
+def _cluster_detectable() -> bool:
+    """True if jax's cluster detection would find a multi-process launcher
+    (TPU-pod metadata, slurm, Open MPI...) — metadata probes only, no XLA
+    backend initialization."""
+    try:
+        from jax._src.clusters import ClusterEnv
+
+        return any(
+            not getattr(env, "opt_in_only_method", False) and env.is_env_present()
+            for env in ClusterEnv._cluster_types
+        )
+    except Exception:  # pragma: no cover — internal API moved; stay safe
+        return False
