@@ -138,3 +138,24 @@ class TestConfig:
             assert tdx_config.get().log_level == "DEBUG"
         finally:
             tdx_config.set_flags(log_level=before)
+
+
+class TestRunElasticAsync:
+    def test_async_checkpoints_recover(self, tmp_path):
+        import jax.numpy as jnp
+
+        calls = {"n": 0}
+
+        def step(state, batch):
+            calls["n"] += 1
+            if calls["n"] == 4:
+                raise _Boom("injected")
+            return {"x": state["x"] + batch}, {}
+
+        out, steps, restarts = run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(i) for i in range(1, 7)],
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            retry_on=(_Boom,), max_restarts=2, async_checkpoints=True,
+        )
+        assert (steps, restarts) == (6, 1)
+        assert float(out["x"]) == 21.0

@@ -1,11 +1,13 @@
 """Auxiliary subsystems: checkpoint/resume, failure detection/elastic
 recovery, profiling, logging/metrics."""
 
+from .checkpoint import AsyncCheckpointSaver, restore_checkpoint, save_checkpoint
 from .failures import FailureDetector, device_health, run_elastic
 from .logging import Metrics, get_logger
 from .profiling import StepTimer, Timer, annotate, trace
 
 __all__ = [
+    "AsyncCheckpointSaver",
     "FailureDetector",
     "Metrics",
     "StepTimer",
@@ -13,6 +15,8 @@ __all__ = [
     "annotate",
     "device_health",
     "get_logger",
+    "restore_checkpoint",
     "run_elastic",
+    "save_checkpoint",
     "trace",
 ]

@@ -39,6 +39,39 @@ def save_checkpoint(path: str | Path, state: Any, *, force: bool = True) -> None
     ckptr.wait_until_finished()
 
 
+class AsyncCheckpointSaver:
+    """Non-blocking sharded saves: :meth:`save` kicks off the device→host
+    copy and returns; serialization to disk proceeds on orbax's background
+    thread while training continues — the standard TPU pattern for hiding
+    checkpoint latency behind compute.  Call :meth:`wait_until_finished`
+    (or use as a context manager) before reading the files or exiting.
+    """
+
+    def __init__(self) -> None:
+        _require_orbax()
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def save(self, path: str | Path, state: Any, *, force: bool = True) -> None:
+        self._ckptr.save(
+            Path(path).absolute(), args=ocp.args.StandardSave(state), force=force
+        )
+
+    def wait_until_finished(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self._ckptr.close()
+
+    def __enter__(self) -> "AsyncCheckpointSaver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self.wait_until_finished()
+        finally:
+            self.close()  # always release orbax's background thread
+
+
 def restore_checkpoint(
     path: str | Path,
     *,

@@ -121,6 +121,7 @@ def run_elastic(
     max_restarts: int = 3,
     retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
     on_metrics: Optional[Callable[[int, Any], None]] = None,
+    async_checkpoints: bool = False,
 ):
     """Run ``state, metrics = step_fn(state, batch)`` over ``batches`` with
     checkpoint-restart elasticity.
@@ -133,6 +134,11 @@ def run_elastic(
     up to ``max_restarts`` times.  Re-raises on budget exhaustion or any
     non-listed exception (fail fast on real bugs).
 
+    With ``async_checkpoints=True`` periodic saves return immediately and
+    serialize on a background thread (checkpoint latency hides behind the
+    next steps); the loop waits for in-flight writes only before a restore
+    and at exit, so recovery never reads a half-written checkpoint.
+
     Returns ``(state, steps_completed, restarts_used)``.
     """
     log = get_logger()
@@ -141,14 +147,22 @@ def run_elastic(
     restarts = 0
     step = 0
     last_saved: Optional[int] = None
+    async_saver = None
+    if async_checkpoints and checkpoint_dir is not None:
+        from .checkpoint import AsyncCheckpointSaver
+
+        async_saver = AsyncCheckpointSaver()
 
     def save(step_now: int, state_now: Any) -> None:
         nonlocal last_saved
         if checkpoint_dir is None:
             return
-        from .checkpoint import save_checkpoint
+        if async_saver is not None:
+            async_saver.save(f"{checkpoint_dir}/step_{step_now}", state_now)
+        else:
+            from .checkpoint import save_checkpoint
 
-        save_checkpoint(f"{checkpoint_dir}/step_{step_now}", state_now)
+            save_checkpoint(f"{checkpoint_dir}/step_{step_now}", state_now)
         last_saved = step_now
 
     def restore() -> Tuple[int, Any]:
@@ -157,6 +171,8 @@ def run_elastic(
                 "run_elastic: failure with no checkpoint to restore "
                 "(set checkpoint_dir to enable recovery)."
             )
+        if async_saver is not None:  # commit any in-flight write first
+            async_saver.wait_until_finished()
         from .checkpoint import restore_checkpoint
 
         return last_saved, restore_checkpoint(
@@ -164,27 +180,41 @@ def run_elastic(
         )
 
     # Step-0 checkpoint so a failure before the first periodic save is
-    # still recoverable.
-    save(0, state)
+    # still recoverable.  The finally block commits any in-flight async
+    # write even on a re-raise, so the checkpoint a caller would resume
+    # from is never left half-written.
+    try:
+        save(0, state)
 
-    while step < len(batches):
-        try:
-            state, metrics = step_fn(state, batches[step])
-            step += 1
-            if on_metrics is not None:
-                on_metrics(step, metrics)
-            if checkpoint_dir is not None and step % checkpoint_every == 0:
-                save(step, state)
-        except retry_on as e:
-            restarts += 1
-            if restarts > max_restarts:
-                log.error("run_elastic: restart budget exhausted (%d)", max_restarts)
-                raise
-            log.warning(
-                "run_elastic: step %d failed (%s: %s); restoring step %s "
-                "(restart %d/%d)",
-                step, type(e).__name__, str(e)[:120], last_saved,
-                restarts, max_restarts,
-            )
-            step, state = restore()
+        while step < len(batches):
+            try:
+                state, metrics = step_fn(state, batches[step])
+                step += 1
+                if on_metrics is not None:
+                    on_metrics(step, metrics)
+                if checkpoint_dir is not None and step % checkpoint_every == 0:
+                    save(step, state)
+            except retry_on as e:
+                restarts += 1
+                if restarts > max_restarts:
+                    log.error(
+                        "run_elastic: restart budget exhausted (%d)", max_restarts
+                    )
+                    raise
+                log.warning(
+                    "run_elastic: step %d failed (%s: %s); restoring step %s "
+                    "(restart %d/%d)",
+                    step, type(e).__name__, str(e)[:120], last_saved,
+                    restarts, max_restarts,
+                )
+                step, state = restore()
+    finally:
+        if async_saver is not None:
+            try:
+                async_saver.wait_until_finished()
+            finally:
+                # close() must run (else orbax's thread leaks), and a
+                # failed background write must not mask an in-flight
+                # training exception (it stays visible as __context__).
+                async_saver.close()
     return state, step, restarts
