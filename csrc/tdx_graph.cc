@@ -20,6 +20,9 @@
 #include <unordered_set>
 #include <vector>
 
+#define TDX_BUILDING_DLL
+#include "include/tdx_graph.h"  // public C API — keeps signatures in sync
+
 #define TDX_API extern "C" __attribute__((visibility("default")))
 
 namespace {
