@@ -279,6 +279,15 @@ def _bwd_dkv_kernel(
 # ---------------------------------------------------------------------------
 
 
+def _delta_carrier(do, out, block_q, lse_shape):
+    """delta = rowsum(do * out), padded and lane-broadcast to match the
+    lse carrier layout (Mosaic block-tiling rule; kernels read lane 0).
+    Loop-invariant for ring callers — compute once and pass as
+    ``delta3``."""
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(_pad_seq(delta, block_q)[:, :, None], lse_shape)
+
+
 def _pad_seq(x: jax.Array, block: int) -> jax.Array:
     """Zero-pad axis 1 (sequence / row dim) up to a multiple of ``block``."""
     pad = (-x.shape[1]) % block
@@ -333,20 +342,20 @@ def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
     return out[:, :S], lse  # lse stays padded; backward re-pads to match
 
 
-def _bwd_call(qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret):
+def _bwd_call(
+    qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
+    delta3=None,
+):
     BH, S, D = qh.shape
     T = kh.shape[1]
     BKV = kh.shape[0]
     sm_scale = 1.0 / math.sqrt(D)
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if delta3 is None:
+        delta3 = _delta_carrier(do, out, block_q, lse.shape)
     qp, dop = _pad_seq(qh, block_q), _pad_seq(do, block_q)
     kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
-    # Row carriers (lse, delta) ride at full lane width like the forward's
-    # lse output (Mosaic block-tiling rule); kernels read lane 0.
-    dp = jnp.broadcast_to(
-        _pad_seq(delta, block_q)[:, :, None], lse.shape
-    )
+    dp = delta3  # [BH, Sq_padded, _LANES] like lse
     lsep = lse  # [BH, Sq_padded, _LANES], padded by fwd
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 

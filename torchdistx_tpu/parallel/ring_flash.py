@@ -1,0 +1,263 @@
+"""Ring attention with pallas flash-kernel block compute.
+
+The dense ring (`ring_attention.py`) materializes each [s, t] block of
+logits in registers/HBM via XLA einsums.  This variant runs every ring
+step through the blockwise pallas kernels (`ops/flash_attention.py`), so
+per-device memory stays O(block_q x block_k) even for the *local* chunk —
+the composition of the two long-context mechanisms: ring for the
+cross-device sequence axis, flash for the on-device one.  (The reference
+has no long-context layer at all, SURVEY.md §5; this is the TPU-native
+design the charter calls for.)
+
+Scheme (per device, inside ``shard_map``; local q [B, s, H, D], k/v
+[B, t, KV, D], ``n`` devices on the ring):
+
+* forward — each step holds key block ``src = (idx - i) % n``.  Under
+  causal masking a block is *past* (full, un-masked flash), *diagonal*
+  (causal flash), or *future* (skipped via ``lax.switch``).  Each step
+  yields a block output and block logsumexp; blocks merge with the
+  standard pairwise softmax-merge (rescale by ``exp(lse - max)``) so the
+  result is exactly the global softmax.
+* backward — a second ring pass.  The flash backward kernels recompute
+  block probabilities from the *global* lse (``p = exp(s - lse)``), which
+  makes each block's dq/dk/dv contribution globally normalized; dq
+  accumulates locally while dk/dv accumulators rotate with their k/v
+  blocks, arriving home after the full cycle (ring-flash backward).
+
+Gradients are exact: verified against the dense oracle in
+tests/test_parallel.py::TestRingFlash.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.flash_attention import _bwd_call, _fwd_call, _pad_seq, _round8
+from ._attn_wrap import wrap_seq_parallel_attn
+from .collectives import ppermute_next
+
+_NEG = -1e30
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Pairwise softmax merge of two normalized block outputs.
+
+    ``o``/``o_i`` are [BH, s, D] normalized attention outputs, ``lse``/
+    ``lse_i`` their [BH, s] logsumexps; returns the merged pair."""
+    m = jnp.maximum(lse, lse_i)
+    w = jnp.exp(lse - m)
+    w_i = jnp.exp(lse_i - m)
+    denom = w + w_i
+    o = (o * w[..., None] + o_i * w_i[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    BH, s, D = qh.shape
+
+    def flash_block(k_cur, v_cur, blk_causal):
+        out, lse3 = _fwd_call(qh, k_cur, v_cur, groups, blk_causal, bq, bk, interpret)
+        return out.astype(jnp.float32), lse3[:, :s, 0]
+
+    def step(i, carry):
+        o, lse, k_cur, v_cur = carry
+        if causal:
+            src = (idx - i) % n
+            o_i, lse_i = lax.switch(
+                jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
+                [
+                    lambda kv: flash_block(kv[0], kv[1], False),  # past: full
+                    lambda kv: flash_block(kv[0], kv[1], True),  # diagonal
+                    lambda kv: (  # future: contributes nothing
+                        jnp.zeros((BH, s, D), jnp.float32),
+                        jnp.full((BH, s), _NEG, jnp.float32),
+                    ),
+                ],
+                (k_cur, v_cur),
+            )
+        else:
+            o_i, lse_i = flash_block(k_cur, v_cur, False)
+        o, lse = _merge(o, lse, o_i, lse_i)
+        return o, lse, ppermute_next(k_cur, axis_name), ppermute_next(v_cur, axis_name)
+
+    o0 = jnp.zeros((BH, s, D), jnp.float32)
+    lse0 = jnp.full((BH, s), _NEG, jnp.float32)
+    o, lse, _, _ = lax.fori_loop(0, n, step, (o0, lse0, kh, vh))
+    return o.astype(qh.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
+    out, _ = _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
+    return out
+
+
+def _ring_flash_fwd(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
+    out, lse = _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
+    return out, (qh, kh, vh, out, lse)
+
+
+def _ring_flash_bwd(groups, causal, axis_name, bq, bk, interpret, res, do):
+    qh, kh, vh, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    BH, s, D = qh.shape
+    BKV, t = kh.shape[0], kh.shape[1]
+    # Lane-broadcast padded global lse, the row-carrier layout the
+    # backward kernels consume; delta likewise, hoisted out of the ring
+    # loop (both are loop-invariant).
+    from ..ops.flash_attention import _LANES, _delta_carrier
+
+    lse_p = _pad_seq(lse, bq)  # (BH, s_padded)
+    lse3 = jnp.broadcast_to(lse_p[:, :, None], (BH, lse_p.shape[1], _LANES))
+    delta3 = _delta_carrier(do, out, bq, lse3.shape)
+
+    def grads_block(k_cur, v_cur, blk_causal):
+        dq, dk, dv = _bwd_call(
+            qh, k_cur, v_cur, do, out, lse3, groups, blk_causal, bq, bk,
+            interpret, delta3=delta3,
+        )
+        return dq.astype(jnp.float32), dk.astype(jnp.float32), dv.astype(jnp.float32)
+
+    def step(i, carry):
+        dq, k_cur, v_cur, dk, dv = carry
+        if causal:
+            src = (idx - i) % n
+            dq_i, dk_i, dv_i = lax.switch(
+                jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
+                [
+                    lambda kv: grads_block(kv[0], kv[1], False),
+                    lambda kv: grads_block(kv[0], kv[1], True),
+                    lambda kv: (
+                        jnp.zeros((BH, s, D), jnp.float32),
+                        jnp.zeros((BKV, t, D), jnp.float32),
+                        jnp.zeros((BKV, t, D), jnp.float32),
+                    ),
+                ],
+                (k_cur, v_cur),
+            )
+        else:
+            dq_i, dk_i, dv_i = grads_block(k_cur, v_cur, False)
+        dq = dq + dq_i
+        dk = dk + dk_i
+        dv = dv + dv_i
+        # dk/dv rotate WITH their k/v blocks: after the full cycle each
+        # accumulator arrives back on its block's home device holding
+        # every device's contribution.
+        return (
+            dq,
+            ppermute_next(k_cur, axis_name),
+            ppermute_next(v_cur, axis_name),
+            ppermute_next(dk, axis_name),
+            ppermute_next(dv, axis_name),
+        )
+
+    dq0 = jnp.zeros((BH, s, D), jnp.float32)
+    dkv0 = jnp.zeros((BKV, t, D), jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(0, n, step, (dq0, kh, vh, dkv0, dkv0))
+    return dq.astype(qh.dtype), dk.astype(kh.dtype), dv.astype(vh.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,  # [B, s, H, D] local sequence chunk
+    k: jax.Array,  # [B, t, KV, D]
+    v: jax.Array,  # [B, t, KV, D]
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash-kernel ring attention; call inside ``shard_map``.
+
+    Causal masking requires equal local query/key chunks (self-attention);
+    causal cross-attention should use the dense ring
+    (:func:`ring_attention.ring_attention`), which handles the
+    bottom-right offset."""
+    B, s, H, D = q.shape
+    t, KV = k.shape[1], k.shape[2]
+    if H % KV:
+        raise ValueError(f"Query heads ({H}) must be a multiple of KV heads ({KV}).")
+    if causal and s != t:
+        raise NotImplementedError(
+            "causal ring_flash_attention requires equal q/k chunk lengths; "
+            "use the dense ring for causal cross-attention."
+        )
+    groups = H // KV
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = min(block_q, _round8(s))
+    bk = min(block_k, _round8(t))
+
+    qh = q.transpose(0, 2, 1, 3).reshape(B * H, s, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * KV, t, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * KV, t, D)
+    out = _ring_flash(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
+    return out.reshape(B, H, s, D).transpose(0, 2, 1, 3)
+
+
+def make_ring_flash_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    head_axes: Tuple[str, ...] = ("tp",),
+    block_q: int = 512,
+    block_k: int = 512,
+):
+    """Build an ``AttnFn`` running flash-kernel ring attention over
+    ``mesh`` — the drop-in long-context choice on TPU hardware.
+
+    Additive bias and causal cross-attention fall back to the dense ring
+    (same sharding layout) transparently, so models pass a single
+    ``attn_fn`` and every call pattern works.
+    """
+    from .ring_attention import make_ring_attention, ring_attention
+
+    present = set(mesh.axis_names)
+    if seq_axis not in present:
+        from ..models.layers import default_attention
+
+        return default_attention
+    dense = make_ring_attention(
+        mesh, seq_axis=seq_axis, batch_axes=batch_axes, head_axes=head_axes
+    )
+    b = tuple(a for a in batch_axes if a in present) or None
+    h = tuple(a for a in head_axes if a in present) or None
+
+    def per_device(q, k, v, causal, bias):
+        # bias=None always here: attn_fn routes bias to the dense ring.
+        if causal and q.shape[1] != k.shape[1]:
+            # Causal cross-attention: the dense ring handles the
+            # bottom-right offset the flash path does not.
+            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+        return ring_flash_attention(
+            q, k, v, axis_name=seq_axis, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
+
+    flash_wrapped = wrap_seq_parallel_attn(
+        mesh,
+        name="ring flash attention",
+        spec=P(b, seq_axis, h, None),
+        per_device=per_device,
+    )
+
+    def attn_fn(q, k, v, *, causal=True, bias=None):
+        if bias is not None:
+            return dense(q, k, v, causal=causal, bias=bias)
+        return flash_wrapped(q, k, v, causal=causal)
+
+    return attn_fn
