@@ -450,8 +450,8 @@ def flash_attention(
     *,
     causal: bool = True,
     bias: Optional[jax.Array] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention with the model ``AttnFn`` signature (GQA-aware,
@@ -485,7 +485,7 @@ def flash_attention(
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-def make_flash_attention(*, block_q: int = 512, block_k: int = 512):
+def make_flash_attention(*, block_q: int = 1024, block_k: int = 1024):
     """An ``AttnFn`` with fixed block sizes, for model constructors."""
 
     def attn_fn(q, k, v, *, causal=True, bias=None):

@@ -176,8 +176,8 @@ def ring_flash_attention(
     *,
     axis_name: str = "sp",
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash-kernel ring attention; call inside ``shard_map``.
@@ -214,8 +214,8 @@ def make_ring_flash_attention(
     seq_axis: str = "sp",
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     head_axes: Tuple[str, ...] = ("tp",),
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ):
     """Build an ``AttnFn`` running flash-kernel ring attention over
     ``mesh`` — the drop-in long-context choice on TPU hardware.
