@@ -208,6 +208,46 @@ def phase_mixtral_sharded() -> dict:
     )
 
 
+def phase_llama70b_lower() -> dict:
+    """North-star host-side half (BASELINE config 3): deferred_init a TRUE
+    Llama-3-70B (70.6B params, zero storage) and lower its complete
+    64-way-sharded (fsdp×tp) init program — what a login host does before
+    shipping the program to a v5p-64.  Budgets: <60 s wall, <32 GB RSS."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=64"
+    ).strip()
+    os.environ["TDX_BENCH_PLATFORM"] = "cpu"
+    _init_jax()
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchdistx_tpu.deferred_init import deferred_init
+    from torchdistx_tpu.jax_bridge import lower_init_module
+    from torchdistx_tpu.parallel import fsdp_plan, make_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
+        max_position_embeddings=8192,
+    )
+    t0 = time.perf_counter()
+    m = deferred_init(LlamaForCausalLM, cfg)
+    t_record = time.perf_counter() - t0
+    n_params = sum(p.numel() for p in m.parameters())
+
+    mesh = make_mesh({"fsdp": 8, "tp": 8})
+    t0 = time.perf_counter()
+    lowered, names = lower_init_module(m, mesh=mesh, plan=fsdp_plan(min_size=65536))
+    t_lower = time.perf_counter() - t0
+    return {
+        "record_s": round(t_record, 2),
+        "lower_s": round(t_lower, 2),
+        "n_params": n_params,
+        "n_outputs": len(names),
+        "rss_mb": round(_rss_mb(), 1),
+    }
+
+
 def phase_flash() -> dict:
     """Flash-attention fwd vs stock attention on the default device;
     reports achieved TFLOP/s (compiled path, interpret=False on TPU).
@@ -274,6 +314,7 @@ PHASES = {
     "llama_baseline": phase_llama_baseline,
     "t5_sharded": phase_t5_sharded,
     "mixtral_sharded": phase_mixtral_sharded,
+    "llama70b_lower": phase_llama70b_lower,
     "flash": phase_flash,
 }
 
@@ -353,6 +394,12 @@ def main() -> None:
             out[f"{name}_n_sharded"] = r.get("n_sharded")
         else:
             out[f"{name}_error"] = r["error"][-160:]
+
+    b70 = _run_phase("llama70b_lower", timeout=420.0)
+    if "error" not in b70:
+        out.update({f"llama70b_{k}": v for k, v in b70.items()})
+    else:
+        out["llama70b_error"] = b70["error"][-160:]
 
     flash = _run_phase("flash", timeout=480.0)
     if "error" not in flash:

@@ -3,6 +3,7 @@
 from .compile import build_init_fn
 from .export import export_init, load_exported_init, save_exported_init
 from .materialize import (
+    lower_init_module,
     materialize_module_jax,
     materialize_params_jax,
     materialize_tensor_jax,
@@ -13,6 +14,7 @@ __all__ = [
     "build_init_fn",
     "export_init",
     "load_exported_init",
+    "lower_init_module",
     "save_exported_init",
     "materialize_module_jax",
     "materialize_params_jax",

@@ -26,6 +26,7 @@ __all__ = [
     "named_fake_tensors",
     "materialize_params_jax",
     "materialize_module_jax",
+    "lower_init_module",
 ]
 
 # Init programs execute once for milliseconds; optimized codegen buys
@@ -102,6 +103,27 @@ def _named_entries(module: torch.nn.Module) -> Iterator[Tuple[str, torch.Tensor]
     yield from module.named_buffers(remove_duplicate=False)
 
 
+def _init_and_shardings(
+    fakes: Dict[str, torch.Tensor],
+    mesh: Optional[Mesh],
+    plan: Optional[ShardingPlan],
+):
+    """Shared plumbing: (names, init_fn, out_shardings) for a fake dict —
+    the single place the plan-to-NamedSharding mapping lives, so lowered
+    and live materialization can never diverge."""
+    names = list(fakes.keys())
+    fake_list = [fakes[n] for n in names]
+    init_fn = build_init_fn(fake_list)
+    out_shardings = None
+    if mesh is not None:
+        plan = plan or ShardingPlan()
+        out_shardings = tuple(
+            NamedSharding(mesh, plan.spec_for(n, tuple(f.shape), mesh))
+            for n, f in zip(names, fake_list)
+        )
+    return names, init_fn, out_shardings
+
+
 def materialize_params_jax(
     fakes: Dict[str, torch.Tensor],
     *,
@@ -117,17 +139,7 @@ def materialize_params_jax(
     recorded op number), so results are independent of sharding layout and
     materialization order.
     """
-    names = list(fakes.keys())
-    fake_list = [fakes[n] for n in names]
-    init_fn = build_init_fn(fake_list)
-
-    out_shardings = None
-    if mesh is not None:
-        plan = plan or ShardingPlan()
-        out_shardings = tuple(
-            NamedSharding(mesh, plan.spec_for(n, tuple(f.shape), mesh))
-            for n, f in zip(names, fake_list)
-        )
+    names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
     values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
     return dict(zip(names, values))
 
@@ -147,6 +159,36 @@ def materialize_tensor_jax(
     if mesh is not None:
         out_shardings = (NamedSharding(mesh, spec or PartitionSpec()),)
     return _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)[0]
+
+
+def lower_init_module(
+    module: torch.nn.Module,
+    *,
+    mesh: Optional[Mesh] = None,
+    plan: Optional[ShardingPlan] = None,
+):
+    """Trace and *lower* (without compiling or executing) the full sharded
+    init program of a deferred-init module.
+
+    Returns ``(lowered, names)``: a ``jax.stages.Lowered`` whose StableHLO
+    can be inspected/serialized, and the parameter names its outputs
+    correspond to.  This is the host-side half of the north-star workflow
+    at any scale: a login host can deferred-init a 70B model (fakes, zero
+    storage) and produce the GSPMD-partitioned init program for the pod
+    without ever holding a parameter — the step a reference
+    (torchdistX) user has no counterpart for.
+
+    The PRNG key is a *runtime argument* of the program, not baked in:
+    pass it when executing, e.g.
+    ``lowered.compile(compiler_options={"exec_time_optimization_effort":
+    -1.0})(jax.random.PRNGKey(seed))`` (the low-effort option is what
+    :func:`materialize_module_jax` uses — init programs execute once, so
+    optimized codegen only costs compile wall time).
+    """
+    fakes = named_fake_tensors(module)
+    names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+    jitted = jax.jit(init_fn, out_shardings=out_shardings)
+    return jitted.lower(jax.random.PRNGKey(0)), names
 
 
 def materialize_module_jax(
