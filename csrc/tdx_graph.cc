@@ -115,20 +115,33 @@ std::vector<uint64_t> build_call_stack(Graph& g, Node& node) {
     std::vector<Node*> snapshot;
     snapshot.reserve(included.size());
     for (auto& [id, n] : included) snapshot.push_back(n);
-    // The alias FRONTIER: included nodes plus the transitive dependency
-    // closure over them.  Materialized nodes are never replayed, but
-    // their cached outputs carry the aliasing relation — view chains and
-    // readers hanging off them are otherwise unreachable (mirrors the
-    // Python walk; found by the replay fuzzer's data-ops suite).
+    // The alias FRONTIER: included nodes plus the transitive alias
+    // closure over them, in BOTH directions.  Materialized nodes are
+    // never replayed, but their cached outputs carry the aliasing
+    // relation — dependencies reach the storage's base, and materialized
+    // aliasing DEPENDENTS reach the rest of the alias web hanging off it
+    // (a data-read/in-place chain on the base), whose own non-aliasing
+    // readers (clone/deepcopy) are clobbered by an included mutator of
+    // the shared storage just the same (mirrors the Python walk; soak
+    // fuzzer seeds 1465/1537).
     std::vector<Node*> frontier(snapshot);
     std::unordered_set<uint64_t> fseen;
     for (Node* f : frontier) fseen.insert(f->id);
     for (size_t fi = 0; fi < frontier.size(); ++fi) {
-      for (auto& [dep_id, idx] : frontier[fi]->deps) {
+      Node* f = frontier[fi];
+      for (auto& [dep_id, idx] : f->deps) {
         Node* dep = g.get(dep_id);
         if (dep && !fseen.count(dep->id)) {
           fseen.insert(dep->id);
           frontier.push_back(dep);
+        }
+      }
+      for (uint64_t d_id : f->dependents) {
+        Node* d = g.get(d_id);
+        if (d && !fseen.count(d_id) && d->materialized &&
+            storages_intersect(*d, *f)) {
+          fseen.insert(d_id);
+          frontier.push_back(d);
         }
       }
     }

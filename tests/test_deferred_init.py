@@ -690,3 +690,34 @@ class TestValueReads:
         # read b FIRST, after the region: a's draw must replay before b's
         assert b.tolist() == eb.tolist()
         assert torch.equal(materialize_tensor(a), ea)
+
+
+class TestSetDataLayoutGuard:
+    def test_stride_changing_data_assignment_raises(self):
+        # Same shape, different layout (transposed square): the wrapper's
+        # stride metadata is fixed at construction, so composite-op
+        # decompositions would consult stale contiguity — rejected with
+        # remediation (soak fuzzer seed 2160).
+        import torch
+
+        from torchdistx_tpu.deferred_init import deferred_init
+
+        def build():
+            a = torch.full((2, 2), 1.0)
+            b = torch.full((2, 2), 2.0).t()  # same shape, strides (1, 2)
+            return a, b
+
+        a, b = deferred_init(build)
+        with pytest.raises(NotImplementedError, match="layout-changing"):
+            a.data = b
+
+    def test_non_dense_real_data_assignment_raises(self):
+        # empty_like would contiguize a stepped real tensor and slip the
+        # guard; the meta must preserve the source's exact strides.
+        import torch
+
+        from torchdistx_tpu.deferred_init import deferred_init
+
+        a = deferred_init(lambda: torch.zeros(2))
+        with pytest.raises(NotImplementedError, match="layout-changing"):
+            a.data = torch.arange(4.0)[::2]  # strides (2,) vs meta (1,)

@@ -129,7 +129,12 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                 i = rng.randrange(len(pool))
                 cands = [
                     j for j, t in enumerate(pool)
-                    if t.shape == pool[i].shape and t is not pool[i]
+                    # matching strides too: layout-changing .data
+                    # assignment on fakes raises by documented contract
+                    # (fake.py _set_data; soak seed 2160)
+                    if t.shape == pool[i].shape
+                    and t.stride() == pool[i].stride()
+                    and t is not pool[i]
                 ]
                 if not cands:
                     continue
@@ -284,3 +289,23 @@ def test_data_ops_and_value_reads_match_eager(seed):
     reals = _materialize_all(fakes)
     for k, (a, b) in enumerate(zip(eager, reals)):
         assert torch.equal(a, b), f"seed={seed} pool[{k}] {steps}"
+
+
+@pytest.mark.parametrize("seed", [1465, 1537, 5061])
+def test_soak_regression_clone_of_materialized_chain(seed):
+    # Soak-fuzzer regression (round 2): a value read forces early
+    # materialization of a data-read/in-place chain; a recorded deepcopy
+    # of the chain tip must replay BEFORE a later in-place RNG op on the
+    # chain's base storage mutates the cached outputs.  Requires the
+    # call-stack walk's alias frontier to follow materialized aliasing
+    # DEPENDENTS, not just dependencies — in both graph engines.
+    steps = _gen_program(
+        random.Random(seed), allow_rng_ops=True, allow_data_ops=True
+    )
+    torch.manual_seed(777)
+    eager = run(steps)
+    torch.manual_seed(777)
+    fakes = deferred_init(run, steps)
+    reals = _materialize_all(fakes)
+    for k, (a, b) in enumerate(zip(eager, reals)):
+        assert torch.equal(a, b), f"seed={seed} pool[{k}]"

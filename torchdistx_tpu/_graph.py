@@ -467,22 +467,33 @@ class OpNode:
         while changed:
             changed = False
             nodes_now = list(included.values())
-            # The alias FRONTIER: included nodes plus their (possibly
-            # already materialized) dependencies.  Materialized nodes are
+            # The alias FRONTIER: included nodes plus their transitive
+            # alias closure, in BOTH directions.  Materialized nodes are
             # never replayed, but their cached outputs still carry the
-            # aliasing relation — dependents hanging off them (view
-            # chains, readers) are otherwise unreachable from the
-            # included set (found by the replay fuzzer's data-ops suite).
+            # aliasing relation — dependencies reach the storage's base,
+            # and materialized aliasing DEPENDENTS reach the rest of the
+            # alias web hanging off it (e.g. a data-read→add_→zero_ chain
+            # on the base), whose own non-aliasing readers (clone/
+            # deepcopy) are clobbered by an included mutator of the
+            # shared storage just the same (replay fuzzer data-ops suite;
+            # soak seeds 1465/1537).
             frontier = list(nodes_now)
             fseen = {id(f) for f in frontier}
             fi = 0
-            while fi < len(frontier):  # transitive dependency closure:
-                # materialized view chains (flatten→full) carry aliasing
-                # through multiple hops the included set never replays.
-                for dep, _ in frontier[fi].dependencies:
+            while fi < len(frontier):
+                f = frontier[fi]
+                for dep, _ in f.dependencies:
                     if id(dep) not in fseen:
                         fseen.add(id(dep))
                         frontier.append(dep)
+                for d in f.dependents:
+                    if (
+                        id(d) not in fseen
+                        and d.materialized
+                        and d.storages & f.storages
+                    ):
+                        fseen.add(id(d))
+                        frontier.append(d)
                 fi += 1
             for f in frontier:
                 # (a) aliasing dependents of any frontier node replay too

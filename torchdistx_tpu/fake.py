@@ -244,8 +244,12 @@ class FakeTensor(torch.Tensor):
             n = meta.untyped_storage().nbytes() // meta.element_size()
             full_copy = self.detach().as_strided((n,), (1,), 0).clone()
             memo[skey] = full_copy
+        # Geometry from the META, not the wrapper: after `p.data = w` the
+        # wrapper's construction-time storage_offset is stale (the meta
+        # swapped to w's storage, where the view starts at w's offset) —
+        # soak fuzzer seed 5061.
         out = full_copy.as_strided(
-            tuple(self.shape), tuple(self.stride()), self.storage_offset()
+            tuple(meta.shape), tuple(meta.stride()), meta.storage_offset()
         )
         if src_ctx is not None and get_fake_context(out, _graph.CONTEXT_KEY) is None:
             # Outside the recording region the clone cannot be recorded —
@@ -323,7 +327,12 @@ def _set_data(fake: FakeTensor, new: torch.Tensor) -> None:
     if is_fake(new):
         new_meta = new._meta.detach()  # shares storage: p.data = w aliases w
     else:
-        new_meta = torch.empty_like(new, device="meta")
+        # empty_like contiguizes non-dense inputs, which would let a
+        # genuinely layout-differing assignment slip past the stride
+        # guard below; preserve the real tensor's strides exactly.
+        new_meta = torch.empty_strided(
+            new.shape, new.stride(), dtype=new.dtype, device="meta"
+        )
     if new_meta.shape != fake._meta.shape or new_meta.dtype != fake._meta.dtype:
         raise NotImplementedError(
             f"shape- or dtype-changing `.data` assignment on a fake tensor "
@@ -331,6 +340,17 @@ def _set_data(fake: FakeTensor, new: torch.Tensor) -> None:
             f"{fake._meta.dtype}, new {tuple(new_meta.shape)}/"
             f"{new_meta.dtype}). Assign a tensor of matching metadata, or "
             f"construct the module with the target shape."
+        )
+    if new_meta.stride() != fake._meta.stride():
+        # The wrapper's size/stride are fixed at construction; a
+        # layout-changing swap would leave composite-op decompositions
+        # (flatten -> view vs reshape) consulting stale contiguity and
+        # replaying incorrectly (soak fuzzer, seed 2160).
+        raise NotImplementedError(
+            f"layout-changing `.data` assignment on a fake tensor is not "
+            f"supported (old strides {fake._meta.stride()}, new "
+            f"{new_meta.stride()}). Assign a tensor with matching strides "
+            f"(e.g. `.contiguous()` — note that drops storage aliasing)."
         )
     fake._meta = new_meta
     setattr(new_meta, _attr_name_of_meta_owner(), weakref.ref(fake))
