@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-cmake leak-check test wheel clean
+.PHONY: native native-test native-test-build native-cmake leak-check test wheel clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -13,10 +13,12 @@ native:
 	g++ $(NATIVE_CXXFLAGS) $(SAN) -shared \
 	    -o torchdistx_tpu/_lib/libtdxgraph.so csrc/tdx_graph.cc
 
-native-test:
+native-test-build:
 	mkdir -p csrc/build
 	g++ $(NATIVE_CXXFLAGS) $(SAN) \
 	    -o csrc/build/test_graph csrc/tdx_graph.cc csrc/test_graph.cc
+
+native-test: native-test-build
 	./csrc/build/test_graph
 
 native-cmake:
@@ -29,9 +31,7 @@ native-cmake:
 # library — a tdx_*/libtdxgraph frame inside a leak trace fails the
 # build, anything else is tolerated.
 leak-check:
-	mkdir -p csrc/build
-	g++ $(NATIVE_CXXFLAGS) -fsanitize=address -fno-omit-frame-pointer \
-	    -o csrc/build/test_graph csrc/tdx_graph.cc csrc/test_graph.cc
+	$(MAKE) native-test-build SAN="-fsanitize=address -fno-omit-frame-pointer"
 	ASAN_OPTIONS=detect_leaks=1:exitcode=0 ./csrc/build/test_graph \
 	    2> /tmp/tdx_lsan.log
 	@if grep -E "#[0-9]+ .*(tdx_|libtdxgraph)" /tmp/tdx_lsan.log; then \

@@ -11,8 +11,19 @@ from pathlib import Path
 
 from setuptools import Command, find_packages, setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
 
 ROOT = Path(__file__).parent
+
+
+class BinaryDistribution(Distribution):
+    """Mark the distribution non-pure so wheels carry a platform tag:
+    the bundled libtdxgraph.so is a native ELF, and a py3-none-any tag
+    would let one x86_64 build shadow every platform (reference parity:
+    its setup.py marks non-pure, setup.py:22-27 there)."""
+
+    def has_ext_modules(self):
+        return True
 
 
 class build_native(Command):
@@ -60,4 +71,5 @@ setup(
         "test": ["pytest"],
     },
     cmdclass={"build_native": build_native, "build_py": build_py_with_native},
+    distclass=BinaryDistribution,
 )
