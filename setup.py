@@ -6,6 +6,7 @@ is compiled into the package's ``_lib`` directory at build time; the
 package remains fully functional without it (pure-Python fallback).
 """
 
+import shutil
 import subprocess
 from pathlib import Path
 
@@ -17,13 +18,20 @@ ROOT = Path(__file__).parent
 
 
 class BinaryDistribution(Distribution):
-    """Mark the distribution non-pure so wheels carry a platform tag:
-    the bundled libtdxgraph.so is a native ELF, and a py3-none-any tag
-    would let one x86_64 build shadow every platform (reference parity:
-    its setup.py marks non-pure, setup.py:22-27 there)."""
+    """Mark the distribution non-pure when it bundles the native engine,
+    so those wheels carry a platform tag: the .so is a native ELF, and a
+    py3-none-any tag would let one x86_64 build shadow every platform
+    (reference parity: its setup.py marks non-pure, setup.py:22-27
+    there).  A build without the optional native lib stays pure — the
+    package is fully functional in pure Python."""
 
     def has_ext_modules(self):
-        return True
+        # Consulted by bdist_wheel BEFORE build commands run: a prebuilt
+        # .so or a usable compiler both mean the wheel will be binary
+        # (build_py_with_native makes a failed compile fatal in the
+        # latter case, so the tag always reflects the contents).
+        prebuilt = list((ROOT / "torchdistx_tpu" / "_lib").glob("*.so"))
+        return bool(prebuilt) or shutil.which("g++") is not None
 
 
 class build_native(Command):
@@ -43,10 +51,14 @@ class build_native(Command):
 
 class build_py_with_native(build_py):
     def run(self):
-        try:
+        if shutil.which("g++") is None:
+            # No compiler: a pure wheel (has_ext_modules False agrees).
+            print("warning: native build skipped (no g++ on PATH)")
+        else:
+            # Compiler present: has_ext_modules already promised a binary
+            # wheel, so a build failure must fail the build rather than
+            # silently produce a platform-tagged wheel with no .so.
             self.run_command("build_native")
-        except Exception as e:  # native is optional
-            print(f"warning: native build skipped ({e})")
         super().run()
 
 

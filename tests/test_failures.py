@@ -159,3 +159,98 @@ class TestRunElasticAsync:
         )
         assert (steps, restarts) == (6, 1)
         assert float(out["x"]) == 21.0
+
+
+class TestCrossProcessResume:
+    def test_resume_from_previous_run(self, tmp_path):
+        import jax.numpy as jnp
+
+        # "Process 1" dies (budget exhausted) partway through.
+        calls = {"n": 0}
+
+        def flaky(state, batch):
+            calls["n"] += 1
+            if calls["n"] >= 4:
+                raise _Boom("preempted")
+            return {"x": state["x"] + batch}, {}
+
+        batches = [jnp.float32(i) for i in range(1, 7)]
+        with pytest.raises(_Boom):
+            run_elastic(
+                flaky, {"x": jnp.float32(0.0)}, batches,
+                checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                retry_on=(_Boom,), max_restarts=0,
+            )
+
+        # "Process 2": fresh invocation, resume=True picks up step_2 on
+        # disk and completes the remaining steps.
+        def step(state, batch):
+            return {"x": state["x"] + batch}, {}
+
+        out, steps, restarts = run_elastic(
+            step, {"x": jnp.float32(0.0)}, batches,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            retry_on=(_Boom,), resume=True,
+        )
+        assert steps == 6
+        assert float(out["x"]) == 21.0  # deterministic: sum 1..6
+
+    def test_max_to_keep_prunes(self, tmp_path):
+        import os
+
+        import jax.numpy as jnp
+
+        def step(state, batch):
+            return {"x": state["x"] + batch}, {}
+
+        run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)] * 8,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            max_to_keep=2,
+        )
+        steps_on_disk = sorted(
+            int(n.split("_")[1]) for n in os.listdir(tmp_path)
+            if n.startswith("step_")
+        )
+        assert steps_on_disk == [6, 8]
+
+    def test_resume_empty_dir_starts_fresh(self, tmp_path):
+        import jax.numpy as jnp
+
+        def step(state, batch):
+            return {"x": state["x"] + batch}, {}
+
+        out, steps, _ = run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(2.0)] * 3,
+            checkpoint_dir=str(tmp_path), resume=True,
+        )
+        assert steps == 3 and float(out["x"]) == 6.0
+
+    def test_max_to_keep_zero_rejected(self, tmp_path):
+        import jax.numpy as jnp
+
+        with pytest.raises(ValueError, match="max_to_keep"):
+            run_elastic(
+                lambda s, b: (s, {}), {"x": jnp.float32(0.0)},
+                [jnp.float32(1.0)], checkpoint_dir=str(tmp_path),
+                max_to_keep=0,
+            )
+
+    def test_max_to_keep_prunes_async(self, tmp_path):
+        import os
+
+        import jax.numpy as jnp
+
+        def step(state, batch):
+            return {"x": state["x"] + batch}, {}
+
+        run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)] * 8,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            max_to_keep=2, async_checkpoints=True,
+        )
+        steps_on_disk = sorted(
+            int(n.split("_")[1]) for n in os.listdir(tmp_path)
+            if n.startswith("step_")
+        )
+        assert steps_on_disk == [6, 8]

@@ -316,6 +316,13 @@ def is_param_like(tensor: torch.Tensor) -> bool:
 _set_data_recorder: Optional[Any] = None
 
 
+def _effective_strides(t: torch.Tensor) -> tuple:
+    """Strides restricted to dims of size > 1 — the layout-relevant ones
+    (size-1 dims carry arbitrary strides; torch's own contiguity checks
+    skip them)."""
+    return tuple(s for s, n in zip(t.stride(), t.shape) if n > 1)
+
+
 def _set_data(fake: FakeTensor, new: torch.Tensor) -> None:
     """``fake.data = new``: rebind the fake's meta to (a storage-sharing
     view of) ``new``'s metadata, preserving the wrapper object.
@@ -341,11 +348,13 @@ def _set_data(fake: FakeTensor, new: torch.Tensor) -> None:
             f"{new_meta.dtype}). Assign a tensor of matching metadata, or "
             f"construct the module with the target shape."
         )
-    if new_meta.stride() != fake._meta.stride():
+    if _effective_strides(new_meta) != _effective_strides(fake._meta):
         # The wrapper's size/stride are fixed at construction; a
         # layout-changing swap would leave composite-op decompositions
         # (flatten -> view vs reshape) consulting stale contiguity and
-        # replaying incorrectly (soak fuzzer, seed 2160).
+        # replaying incorrectly (soak fuzzer, seed 2160).  Strides of
+        # size-1 dims are layout-irrelevant (and meta vs eager kernels
+        # may normalize them differently, soak seed 20548) — ignored.
         raise NotImplementedError(
             f"layout-changing `.data` assignment on a fake tensor is not "
             f"supported (old strides {fake._meta.stride()}, new "

@@ -24,7 +24,17 @@ target sharding).  This module provides the three pieces of that loop:
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple, Type
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Type,
+)
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +132,8 @@ def run_elastic(
     retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
     on_metrics: Optional[Callable[[int, Any], None]] = None,
     async_checkpoints: bool = False,
+    resume: bool = False,
+    max_to_keep: Optional[int] = None,
 ):
     """Run ``state, metrics = step_fn(state, batch)`` over ``batches`` with
     checkpoint-restart elasticity.
@@ -134,6 +146,13 @@ def run_elastic(
     up to ``max_restarts`` times.  Re-raises on budget exhaustion or any
     non-listed exception (fail fast on real bugs).
 
+    With ``resume=True`` the loop first scans ``checkpoint_dir`` for
+    checkpoints from a PREVIOUS process and continues from the latest —
+    the TPU preemption model: the whole SPMD program dies and is
+    relaunched, so recovery must work across processes, not only within
+    one.  ``max_to_keep`` prunes old step checkpoints after each save
+    (the latest ``max_to_keep`` survive).
+
     With ``async_checkpoints=True`` periodic saves return immediately and
     serialize on a background thread (checkpoint latency hides behind the
     next steps); the loop waits for in-flight writes only before a restore
@@ -142,6 +161,11 @@ def run_elastic(
     Returns ``(state, steps_completed, restarts_used)``.
     """
     log = get_logger()
+    if max_to_keep is not None and max_to_keep < 1:
+        raise ValueError(
+            f"max_to_keep must be >= 1 (got {max_to_keep}); the latest "
+            f"checkpoint is always needed for recovery."
+        )
     retry_on = retry_on or _default_retry_on()
     batches = list(batches)
     restarts = 0
@@ -152,6 +176,19 @@ def run_elastic(
         from .checkpoint import AsyncCheckpointSaver
 
         async_saver = AsyncCheckpointSaver()
+
+    def _on_disk_steps() -> List[int]:
+        import os
+        import re
+
+        if checkpoint_dir is None or not os.path.isdir(checkpoint_dir):
+            return []
+        out = []
+        for name in os.listdir(checkpoint_dir):
+            m = re.fullmatch(r"step_(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
 
     def save(step_now: int, state_now: Any) -> None:
         nonlocal last_saved
@@ -164,6 +201,22 @@ def run_elastic(
 
             save_checkpoint(f"{checkpoint_dir}/step_{step_now}", state_now)
         last_saved = step_now
+        if max_to_keep is not None:
+            import shutil
+
+            if async_saver is not None:
+                # Never delete a durable checkpoint while the replacement
+                # is still an uncommitted tmp dir: a preemption in that
+                # window would leave NOTHING to resume from.  (orbax's
+                # CheckpointManager orders prune-after-commit the same
+                # way; this bespoke layout keeps step_N dirs readable by
+                # plain restore_checkpoint.)
+                async_saver.wait_until_finished()
+            on_disk = _on_disk_steps()
+            keep = set(sorted(set(on_disk) | {step_now})[-max_to_keep:])
+            for s in on_disk:
+                if s not in keep:
+                    shutil.rmtree(f"{checkpoint_dir}/step_{s}", ignore_errors=True)
 
     def restore() -> Tuple[int, Any]:
         if checkpoint_dir is None or last_saved is None:
@@ -184,7 +237,21 @@ def run_elastic(
     # write even on a re-raise, so the checkpoint a caller would resume
     # from is never left half-written.
     try:
-        save(0, state)
+        on_disk = _on_disk_steps() if resume else []
+        if on_disk:
+            from .checkpoint import restore_checkpoint
+
+            last_saved = on_disk[-1]
+            step = last_saved
+            state = restore_checkpoint(
+                f"{checkpoint_dir}/step_{last_saved}", target=state
+            )
+            log.info(
+                "run_elastic: resumed from %s/step_%d (previous process)",
+                checkpoint_dir, last_saved,
+            )
+        else:
+            save(0, state)
 
         while step < len(batches):
             try:
