@@ -1,0 +1,25 @@
+"""Deferred-init an HF model, then materialize it three ways.
+
+Run anywhere (CPU is fine):
+    python examples/deferred_init_hf.py
+"""
+
+import torch
+from transformers import GPT2Config, GPT2LMHeadModel
+
+from torchdistx_tpu.deferred_init import deferred_init, materialize_module
+from torchdistx_tpu.fake import is_fake
+
+# 1. Construct WITHOUT allocating: every parameter is a fake tensor.
+model = deferred_init(GPT2LMHeadModel, GPT2Config())
+print("fake?", is_fake(model.transformer.wte.weight))
+print(model.transformer.wte.weight)  # repr shows fake=True, no storage
+
+# 2a. Materialize in torch (bitwise equal to eager init under a seed).
+torch.manual_seed(0)
+materialize_module(model)
+out = model(torch.randint(0, 50257, (1, 8)))
+print("forward:", tuple(out.logits.shape))
+
+# 2b. ...or compile the recording straight into (sharded) device memory:
+#     see examples/sharded_materialize.py

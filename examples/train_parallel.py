@@ -1,0 +1,32 @@
+"""Train a Llama-family model with sharded init + ring-flash attention
+over a pp-free dp x sp mesh (virtual CPU devices; same code on a pod).
+
+    python examples/train_parallel.py
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from torchdistx_tpu.abstract import deferred_init, materialize
+from torchdistx_tpu.models import TINY, decoder_lm_plan, make_llama
+from torchdistx_tpu.parallel import make_mesh, make_ring_flash_attention
+from torchdistx_tpu.parallel.train import make_train_step
+
+mesh = make_mesh({"dp": 2, "sp": 4})
+model = make_llama(TINY, attn_fn=make_ring_flash_attention(mesh))
+toks = jax.random.randint(jax.random.PRNGKey(0), (8, 32), 0, TINY.vocab_size)
+
+fakes = deferred_init(model.init, jax.random.PRNGKey(0), toks)
+params = materialize(fakes, mesh=mesh, plan=decoder_lm_plan())
+
+init_state, step, shard_batch = make_train_step(model, TINY, mesh)
+state = init_state(params)
+for i in range(5):
+    state, metrics = step(state, shard_batch(toks))
+    print(f"step {i}: loss {float(metrics['loss']):.4f}")
