@@ -463,3 +463,35 @@ class TestRingFlash:
         )(params)
         assert np.isfinite(float(loss))
         assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+
+    def test_pipeline_composes_with_ring_flash(self):
+        # Deepest composition: GPipe over pp x data parallel x ring-flash
+        # sequence parallel, trained end to end on the virtual mesh.
+        from torchdistx_tpu.models import decoder_lm_plan
+        from torchdistx_tpu.parallel import make_ring_flash_attention
+        from torchdistx_tpu.parallel.pipeline import pipeline_plan_overrides
+        from torchdistx_tpu.parallel.sharding import ShardingPlan
+
+        mesh = make_mesh({"pp": 2, "dp": 2, "sp": 2})
+        attn = make_ring_flash_attention(mesh)
+        model = make_llama(TINY, attn_fn=attn)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(0), (8, 32), 0, TINY.vocab_size
+        )
+        fakes = deferred_init(model.init, jax.random.PRNGKey(0), toks)
+        base = decoder_lm_plan(fsdp=None, ep=None)
+        plan = ShardingPlan(
+            pipeline_plan_overrides()
+            + [(p.pattern, s) for p, s in base.rules]
+        )
+        params = materialize(fakes, mesh=mesh, plan=plan)
+        init_state, step, shard_batch = make_train_step(
+            model, TINY, mesh, pipeline=True, n_microbatches=4
+        )
+        state = init_state(params)
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, shard_batch(toks))
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(x) for x in losses)
+        assert losses[-1] < losses[0]
