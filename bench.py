@@ -341,14 +341,22 @@ def main() -> None:
         print(json.dumps(PHASES[sys.argv[2]]()))
         return
 
-    base = _run_phase("gpt2_baseline")
-    ours = _run_phase("gpt2_ours")
-    if "error" in ours:  # one retry: transient tunnel stalls happen
-        ours = _run_phase("gpt2_ours")
+    # Headline phases get a longer budget and retries: the axon tunnel
+    # occasionally wedges for minutes (observed: a fresh process hangs in
+    # backend init), and the whole scoreboard rides on these two numbers.
+    base = _run_phase("gpt2_baseline", timeout=900.0)
+    ours = _run_phase("gpt2_ours", timeout=900.0)
+    for _ in range(2):
+        if "error" not in ours:
+            break
+        time.sleep(60.0)  # give a wedged tunnel a chance to recover
+        ours = _run_phase("gpt2_ours", timeout=900.0)
     if "error" in ours:
         print(json.dumps({"metric": "bench failed", "value": 0, "unit": "s",
                           "vs_baseline": 0, "detail": ours["error"]}))
         return
+    if "error" in base:
+        base = _run_phase("gpt2_baseline", timeout=900.0)
 
     out = {
         "metric": "gpt2-125m deferred_init→device materialize+touch wall time",
