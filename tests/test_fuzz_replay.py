@@ -329,13 +329,18 @@ def test_soak_regression_clone_of_materialized_chain(seed):
         assert torch.equal(a, b), f"seed={seed} pool[{k}]"
 
 
-@pytest.mark.parametrize("seed", range(4 * N_PROGRAMS, 4 * N_PROGRAMS + 12))
+@pytest.mark.parametrize("seed", range(4 * N_PROGRAMS, 4 * N_PROGRAMS + 24))
 def test_serialize_roundtrip_matches_eager(seed, tmp_path):
     # save_recording → load_recording → materialize must equal eager for
     # random deterministic programs (the login-host → pod workflow).
     from torchdistx_tpu.serialize import load_recording, save_recording
 
-    steps = _gen_program(random.Random(seed), allow_rng_ops=False)
+    # Half the seeds include .data ops so synthetic tdx::set_data nodes
+    # flow through the codec; value reads may early-materialize chains,
+    # which save_recording rejects -> skip path below.
+    steps = _gen_program(
+        random.Random(seed), allow_rng_ops=False, allow_data_ops=seed % 2 == 0
+    )
     eager = run(steps)
     fakes = deferred_init(run, steps)
     wanted = {str(k): t for k, t in enumerate(fakes) if is_fake(t)}
@@ -348,6 +353,12 @@ def test_serialize_roundtrip_matches_eager(seed, tmp_path):
         # Only the documented cannot-serialize signals may skip; any
         # other RuntimeError is a real serialization bug and must fail.
         if "serial" not in str(e):
+            raise
+        pytest.skip(f"recording not serializable: {str(e)[:80]}")
+    except ValueError as e:
+        # Documented: value reads early-materialize chains, and partially
+        # materialized recordings are not saveable.
+        if "materialized" not in str(e):
             raise
         pytest.skip(f"recording not serializable: {str(e)[:80]}")
     loaded = load_recording(p)
