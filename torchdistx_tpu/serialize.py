@@ -246,6 +246,15 @@ def save_recording(obj: Union[torch.nn.Module, Dict[str, torch.Tensor]], path) -
         _graph._verify_external_args(n)
         for dep, _ in n.dependencies:
             if id(dep) not in index:
+                if dep.materialized:
+                    # Same condition as the in-set check above, detected
+                    # on the dependency side: a value read materialized
+                    # part of the chain early.
+                    raise ValueError(
+                        f"Op `{n.op.name}` depends on an already "
+                        f"(partially) materialized op (`{dep.op.name}`); "
+                        f"only unmaterialized recordings can be saved."
+                    )
                 raise RuntimeError(
                     f"Recording is not self-contained: `{n.op.name}` depends "
                     f"on an op outside the saved set."
