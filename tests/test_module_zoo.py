@@ -69,3 +69,116 @@ def test_forward_after_materialize():
     y = d(torch.randn(2, 3, 16, 16))
     assert y.shape == (2, 2, 16, 16)
     assert torch.isfinite(y).all()
+
+
+# ---------------------------------------------------------------------------
+# Random module-tree fuzz: compose the zoo into random nested containers
+# with custom-init quirks (.data writes, no_grad fills, tied weights) and
+# require bitwise eager parity through deferred_init -> materialize.
+# ---------------------------------------------------------------------------
+
+_LEAVES = [
+    lambda rng: nn.Linear(rng.choice([4, 8]), rng.choice([4, 8])),
+    lambda rng: nn.Embedding(16, rng.choice([4, 8])),
+    lambda rng: nn.LayerNorm(rng.choice([4, 8])),
+    lambda rng: nn.Conv1d(2, 4, 3),
+    lambda rng: nn.GRU(4, 8),
+    lambda rng: nn.BatchNorm1d(4),
+]
+
+
+class _CustomInit(nn.Module):
+    """HF-style _init_weights quirks: .data writes and no_grad fills."""
+
+    def __init__(self, rng):
+        super().__init__()
+        self.lin = nn.Linear(8, 8)
+        self.register_buffer("scale", torch.ones(8))
+        style = rng.randrange(3)
+        if style == 0:
+            self.lin.weight.data.normal_(0.0, 0.02)
+            self.lin.bias.data.zero_()
+        elif style == 1:
+            with torch.no_grad():
+                self.lin.weight.fill_(0.5)
+        else:
+            self.lin.weight.data.mul_(2.0)
+            self.scale.mul_(3.0)
+
+
+class _Tied(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(16, 8)
+        self.head = nn.Linear(8, 16, bias=False)
+        self.head.weight = self.emb.weight  # weight tying
+
+
+def _random_tree(rng, depth=0):
+    roll = rng.random()
+    if depth >= 2 or roll < 0.45:
+        if roll < 0.08:
+            return _Tied()
+        if roll < 0.2:
+            return _CustomInit(rng)
+        return rng.choice(_LEAVES)(rng)
+    n = rng.randint(2, 3)
+    children = [_random_tree(rng, depth + 1) for _ in range(n)]
+    if rng.random() < 0.5:
+        return nn.Sequential(*children)
+    holder = nn.Module()
+    for i, c in enumerate(children):
+        holder.add_module(f"m{i}", c)
+    return holder
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_random_module_tree_parity(seed):
+    import random
+
+    torch.manual_seed(1000 + seed)
+    eager = _random_tree(random.Random(seed))
+    torch.manual_seed(1000 + seed)
+    deferred = deferred_init(_random_tree, random.Random(seed))
+    assert any(is_fake(p) for p in deferred.parameters())
+    materialize_module(deferred)
+    e = dict(eager.state_dict())
+    d = dict(deferred.state_dict())
+    assert e.keys() == d.keys()
+    for k in e:
+        assert torch.equal(e[k], d[k]), f"seed={seed} {k}"
+
+
+def test_tied_discard_parity_and_cross_session_isolation():
+    # 1. An init overwritten by tying consumed eager RNG draws; whole-
+    #    module materialization must replay them (dead draws) for parity.
+    def build():
+        holder = nn.Module()
+        holder.tied = _Tied()          # Linear init discarded by tying
+        holder.after = nn.Linear(8, 8)  # draws AFTER the discard
+        return holder
+
+    torch.manual_seed(5)
+    eager = build()
+    torch.manual_seed(5)
+    d = deferred_init(build)
+    materialize_module(d)
+    for k in eager.state_dict():
+        assert torch.equal(eager.state_dict()[k], d.state_dict()[k]), k
+
+    # 2. Materializing an OLDER model must not consume a NEWER session's
+    #    pending draws (session-token guard in materialize_many).
+    torch.manual_seed(7)
+    e1 = nn.Linear(4, 4)
+    torch.manual_seed(8)
+    e2 = nn.Linear(4, 4)
+    torch.manual_seed(7)
+    m1 = deferred_init(nn.Linear, 4, 4)
+    torch.manual_seed(8)
+    m2 = deferred_init(nn.Linear, 4, 4)
+    torch.manual_seed(7)
+    materialize_module(m1)   # must not touch m2's recorded draws
+    torch.manual_seed(8)
+    materialize_module(m2)
+    assert torch.equal(e1.weight, m1.weight)
+    assert torch.equal(e2.weight, m2.weight)

@@ -77,6 +77,7 @@ _session_tls = threading.local()
 def begin_recording_session() -> None:
     _session_tls.counter = itertools.count()
     _session_tls.rng_nodes = []
+    _session_tls.token = object()  # identity tag for this session's nodes
 
 
 def end_recording_session() -> None:
@@ -307,12 +308,17 @@ class OpNode:
     __slots__ = (
         "op", "op_nr", "key_nr", "storages", "dependencies", "dependents",
         "argument_versions", "outputs", "materialized", "loaded",
-        "_ng", "_nid", "__weakref__",
+        "session_token", "_ng", "_nid", "__weakref__",
     )
 
     def __init__(self, op: Op, *, key_nr: Optional[int] = None):
         self.op = op
         self.op_nr = _next_op_nr()
+        # Which recording session this node belongs to (None outside a
+        # session): materialize_many's include_session_rng uses it to
+        # replay only the *requested model's* dead RNG draws, never a
+        # newer session's.
+        self.session_token = getattr(_session_tls, "token", None)
         # An explicit key_nr (serialize.load_recording rebuilding saved
         # nodes) must NOT consume the thread-local session counter, or
         # loading a recording mid-session would shift the RNG keys of
@@ -828,7 +834,12 @@ def materialize_graph(node: OpNode, target: ReplayTarget) -> None:
         replay_node(n, target)
 
 
-def materialize_many(fakes: Sequence[FakeTensor], target: Optional[ReplayTarget] = None) -> None:
+def materialize_many(
+    fakes: Sequence[FakeTensor],
+    target: Optional[ReplayTarget] = None,
+    *,
+    include_session_rng: bool = False,
+) -> None:
     """Replay the union of the call stacks of ``fakes`` in global
     chronological (``op_nr``) order.
 
@@ -838,18 +849,44 @@ def materialize_many(fakes: Sequence[FakeTensor], target: Optional[ReplayTarget]
     fixed seed — a property the reference's strictly per-tensor replay
     cannot provide (its RNG draws happen in materialization order,
     deferred_init.cc:636-663).
+
+    ``include_session_rng=True`` additionally replays the recording
+    session's *dead* RNG draws — ops whose outputs no surviving fake
+    reaches, e.g. a parameter that was initialized and then replaced by
+    weight tying (``self.head.weight = self.emb.weight``).  Eager
+    execution consumed those draws, so skipping them would shift the
+    generator stream for every draw recorded after (found by the random
+    module-tree fuzzer).  Whole-module materialization wants this;
+    per-shard paths (FSDP ``param_init_fn``) deliberately do not — the
+    whole point there is replaying only the shard's slice of work.
     """
     target = target or ReplayTarget()
     nodes: List[OpNode] = []
     seen: Set[int] = set()
+
+    def add_stack(root: OpNode) -> None:
+        for n in root.build_call_stack():
+            if id(n) not in seen:
+                seen.add(id(n))
+                nodes.append(n)
+
+    tokens: Set[int] = set()
     for f in fakes:
         ctx = get_fake_context(f, CONTEXT_KEY)
         if ctx is None:
             continue
-        for n in ctx.node.build_call_stack():
-            if id(n) not in seen:
-                seen.add(id(n))
-                nodes.append(n)
+        if ctx.node.session_token is not None:
+            tokens.add(id(ctx.node.session_token))
+        add_stack(ctx.node)
+    if include_session_rng:
+        # Dead draws are tracked per session (rng_nodes resets at each
+        # begin_recording_session); replay only those belonging to the
+        # SAME session(s) as the requested fakes — a newer model's
+        # pending draws must not be consumed (and cached) by an older
+        # model's materialization.
+        for n in getattr(_session_tls, "rng_nodes", []):
+            if not n.materialized and id(n.session_token) in tokens:
+                add_stack(n)
     for n in sorted(nodes, key=lambda n: n.op_nr):
         replay_node(n, target)
 

@@ -241,7 +241,12 @@ def materialize_module(
                 fakes.extend(t for t in mod._parameters.values() if t is not None and is_fake(t))
             fakes.extend(t for t in mod._buffers.values() if t is not None and is_fake(t))
         collect(module)
-        _graph.materialize_many(fakes, target)
+        # Ungated whole-module materialization also replays the session's
+        # dead RNG draws (an init overwritten by weight tying consumed
+        # eager stream positions); partial/gated paths skip them — they
+        # replay only their slice of work by design.
+        whole = check_fn is None and not buffers_only
+        _graph.materialize_many(fakes, target, include_session_rng=whole)
     if check_fn is not None and not check_fn(module):
         return module
 
