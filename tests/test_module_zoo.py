@@ -182,3 +182,23 @@ def test_tied_discard_parity_and_cross_session_isolation():
     materialize_module(m2)
     assert torch.equal(e1.weight, m1.weight)
     assert torch.equal(e2.weight, m2.weight)
+
+
+def test_dead_draws_survive_newer_sessions():
+    # Token-held RNG lists: an OLDER model's dead draws must replay for
+    # parity even after NEWER deferred_init sessions ran in between.
+    def build():
+        holder = nn.Module()
+        holder.tied = _Tied()
+        holder.after = nn.Linear(8, 8)
+        return holder
+
+    torch.manual_seed(11)
+    eager = build()
+    torch.manual_seed(11)
+    m_old = deferred_init(build)
+    _ = deferred_init(nn.Linear, 4, 4)  # newer session resets the TLS list
+    torch.manual_seed(11)
+    materialize_module(m_old)
+    for k in eager.state_dict():
+        assert torch.equal(eager.state_dict()[k], m_old.state_dict()[k]), k

@@ -74,10 +74,26 @@ def _next_op_nr() -> int:
 _session_tls = threading.local()
 
 
+class _SessionToken:
+    """Identity tag for one recording session, carrying the session's
+    RNG-bearing node list.  Nodes hold their token strongly and the token
+    holds the rng list strongly, so a session's dead draws stay reachable
+    exactly as long as any of its nodes (i.e. any of its fakes) lives —
+    materializing model A after model B was recorded still replays A's
+    own dead draws (and never B's)."""
+
+    __slots__ = ("rng_nodes",)
+
+    def __init__(self) -> None:
+        self.rng_nodes: List["OpNode"] = []
+
+
 def begin_recording_session() -> None:
     _session_tls.counter = itertools.count()
-    _session_tls.rng_nodes = []
-    _session_tls.token = object()  # identity tag for this session's nodes
+    _session_tls.token = _SessionToken()
+    # The thread-local list IS the token's list (one object): recording
+    # appends via the TLS alias, consumers reach it via node tokens.
+    _session_tls.rng_nodes = _session_tls.token.rng_nodes
 
 
 def end_recording_session() -> None:
@@ -139,7 +155,10 @@ def flush_pending_rng(target: Optional["ReplayTarget"] = None) -> None:
     # Cleared only after every replay succeeded: a partial failure (e.g.
     # the modified-external-arg check) that constructor code catches must
     # keep the unmaterialized remainder tracked for the next flush.
-    _session_tls.rng_nodes = []
+    # Clear IN PLACE: the list is aliased by the session token
+    # (materialize_many reaches dead draws through it), so rebinding the
+    # TLS name would silently fork the two views.
+    del _session_tls.rng_nodes[:]
 
 
 def _next_key_nr(op_nr: int) -> int:
@@ -870,23 +889,25 @@ def materialize_many(
                 seen.add(id(n))
                 nodes.append(n)
 
-    tokens: Set[int] = set()
+    tokens: Dict[int, _SessionToken] = {}
     for f in fakes:
         ctx = get_fake_context(f, CONTEXT_KEY)
         if ctx is None:
             continue
-        if ctx.node.session_token is not None:
-            tokens.add(id(ctx.node.session_token))
+        tok = ctx.node.session_token
+        if tok is not None:
+            tokens[id(tok)] = tok
         add_stack(ctx.node)
     if include_session_rng:
-        # Dead draws are tracked per session (rng_nodes resets at each
-        # begin_recording_session); replay only those belonging to the
-        # SAME session(s) as the requested fakes — a newer model's
-        # pending draws must not be consumed (and cached) by an older
-        # model's materialization.
-        for n in getattr(_session_tls, "rng_nodes", []):
-            if not n.materialized and id(n.session_token) in tokens:
-                add_stack(n)
+        # Dead draws are tracked on each session's token, reached through
+        # the requested fakes' own nodes — so this replays exactly the
+        # requested models' sessions' pending draws: never a newer
+        # session's (would consume + cache them out of order), and still
+        # correct for an older model after other models were recorded.
+        for tok in tokens.values():
+            for n in tok.rng_nodes:
+                if not n.materialized:
+                    add_stack(n)
     for n in sorted(nodes, key=lambda n: n.op_nr):
         replay_node(n, target)
 

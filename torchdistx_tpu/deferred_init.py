@@ -213,6 +213,7 @@ def materialize_module(
     buffers_only: bool = False,
     check_fn: Optional[Callable[[Module], bool]] = None,
     target: Optional[ReplayTarget] = None,
+    replay_dead_rng: Optional[bool] = None,
     _memo: Optional[dict] = None,
 ) -> Module:
     """Materialize ``module`` and its descendants in place.
@@ -225,6 +226,14 @@ def materialize_module(
     several modules (weight tying, e.g. GPT-2's ``lm_head``/``wte``)
     materializes once, to a single shared real tensor — the reference
     raises "already materialized" on the second occurrence.
+
+    ``replay_dead_rng`` controls whether the sessions' *dead* RNG draws
+    (inits overwritten by weight tying) replay too, keeping the
+    generator stream bitwise-eager (see ``_graph.materialize_many``).
+    Default: on for ungated whole-module calls, off for gated/partial
+    ones; per-shard callers that materialize submodule-by-submodule
+    (e.g. FSDP ``param_init_fn``) must pass ``False`` — each call would
+    otherwise replay the whole session's dead draws out of order.
     """
     if _memo is None:
         _memo = {}
@@ -241,12 +250,11 @@ def materialize_module(
                 fakes.extend(t for t in mod._parameters.values() if t is not None and is_fake(t))
             fakes.extend(t for t in mod._buffers.values() if t is not None and is_fake(t))
         collect(module)
-        # Ungated whole-module materialization also replays the session's
-        # dead RNG draws (an init overwritten by weight tying consumed
-        # eager stream positions); partial/gated paths skip them — they
-        # replay only their slice of work by design.
-        whole = check_fn is None and not buffers_only
-        _graph.materialize_many(fakes, target, include_session_rng=whole)
+        if replay_dead_rng is None:
+            replay_dead_rng = check_fn is None and not buffers_only
+        _graph.materialize_many(
+            fakes, target, include_session_rng=replay_dead_rng
+        )
     if check_fn is not None and not check_fn(module):
         return module
 

@@ -93,7 +93,12 @@ def make_param_init_fn(
     """
 
     def _init(module: torch.nn.Module) -> None:
-        materialize_module(module, check_fn=check_fn, target=target)
+        # Per-shard path: FSDP calls this submodule-by-submodule, so
+        # session-wide dead-RNG replay (whole-module parity machinery)
+        # must stay off — each unit replays only its slice of work.
+        materialize_module(
+            module, check_fn=check_fn, target=target, replay_dead_rng=False
+        )
 
     return _init
 
