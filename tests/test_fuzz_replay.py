@@ -65,8 +65,17 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                 base = pool[i]
                 op = rng.choice(
                     ["select", "narrow", "transpose", "flatten",
-                     "unsqueeze", "expand"]
+                     "unsqueeze", "expand", "chunk"]
                 )
+                if op == "chunk":
+                    # Multi-output view op: every chunk is a distinct
+                    # output of ONE node (per-output-index dependencies).
+                    if base.dim() < 1 or base.shape[0] < 2:
+                        continue
+                    pieces = base.chunk(2, 0)
+                    steps.append((kind, i, op, len(pieces)))
+                    pool.extend(pieces)
+                    continue
                 if op == "unsqueeze":
                     emit((kind, i, op, None), base.unsqueeze(0))
                 elif op == "expand":
@@ -199,6 +208,8 @@ def run(steps):
                 pool.append(base.unsqueeze(0))
             elif op == "expand":
                 pool.append(base.expand(arg, *base.shape[1:]))
+            elif op == "chunk":
+                pool.extend(base.chunk(2, 0))
             else:
                 pool.append(base.flatten())
         elif kind == "inplace_scalar":
