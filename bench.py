@@ -344,15 +344,11 @@ def _preflight_platform() -> str:
     CPU number beats a zero."""
     if os.environ.get("TDX_BENCH_PLATFORM"):
         return ""  # user forced a platform explicitly: not a fallback
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=180.0, cwd=REPO,
-        )
-        if res.returncode == 0:
-            return ""  # default platform is healthy
-    except subprocess.TimeoutExpired:
-        pass
+    sys.path.insert(0, REPO)
+    from torchdistx_tpu._probe import probe_device_count
+
+    if probe_device_count(timeout=180.0) > 0:
+        return ""  # default platform is healthy
     os.environ["TDX_BENCH_PLATFORM"] = "cpu"
     return "cpu(fallback: accelerator backend unreachable)"
 

@@ -72,8 +72,8 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                     # output of ONE node (per-output-index dependencies).
                     if base.dim() < 1 or base.shape[0] < 2:
                         continue
+                    steps.append((kind, i, op, 2))  # arg = chunk count
                     pieces = base.chunk(2, 0)
-                    steps.append((kind, i, op, len(pieces)))
                     pool.extend(pieces)
                     continue
                 if op == "unsqueeze":
@@ -209,7 +209,7 @@ def run(steps):
             elif op == "expand":
                 pool.append(base.expand(arg, *base.shape[1:]))
             elif op == "chunk":
-                pool.extend(base.chunk(2, 0))
+                pool.extend(base.chunk(arg, 0))
             else:
                 pool.append(base.flatten())
         elif kind == "inplace_scalar":

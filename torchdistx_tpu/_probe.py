@@ -1,0 +1,56 @@
+"""Hang-proof accelerator backend probing (stdlib only — importable by
+bench.py and __graft_entry__.py without pulling in torch/jax).
+
+``jax.devices()`` blocks indefinitely when the accelerator tunnel is
+wedged, and its backend init spawns helper processes (the axon relay)
+that inherit stdio — so a probe must (a) run in a throwaway subprocess,
+(b) communicate its result through a FILE rather than a pipe (a helper
+grandchild can hold a pipe open past the child's exit, deadlocking the
+reap even on success), and (c) kill the whole process group on timeout
+(``start_new_session`` + ``killpg``) so the helpers die with the child.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+
+def probe_device_count(timeout: float = 150.0) -> int:
+    """Number of jax devices the default backend exposes, or 0 if the
+    backend is unreachable (hangs, crashes, or cannot spawn)."""
+    fd, path = tempfile.mkstemp(prefix="tdx_probe_")
+    os.close(fd)
+    code = (
+        "import jax; "
+        f"open({path!r}, 'w').write(str(len(jax.devices())))"
+    )
+    try:
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+        except (OSError, subprocess.SubprocessError):
+            return 0
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+            return int(text) if text else 0
+        except (OSError, ValueError):
+            return 0
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
