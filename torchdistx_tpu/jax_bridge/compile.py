@@ -237,6 +237,15 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         base_shape = tuple(box.read().shape)
         fwd, bwd = impl(ctx, base_shape, *rest, **kw)
         env[(id(node), 0)] = ViewBox(box, fwd, bwd)
+    elif kind == "multiview":
+        # One node, several aliasing view outputs (aten.split):
+        # each output gets its own lens over the shared base box.
+        box = _first_dep_box(args, env, node.dependencies)
+        rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
+        kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
+        base_shape = tuple(box.read().shape)
+        for i, (fwd, bwd) in enumerate(impl(ctx, base_shape, *rest, **kw)):
+            env[(id(node), i)] = ViewBox(box, fwd, bwd)
     else:  # pragma: no cover
         raise AssertionError(kind)
 

@@ -568,6 +568,54 @@ def _unsqueeze(ctx, base_shape, dim, **kw):
     )
 
 
+def _slice_lenses(sizes, dim):
+    """One (fwd, bwd) slice lens per consecutive piece along ``dim``."""
+    lenses = []
+    start = 0
+    for ln in sizes:
+        sl = slice(start, start + ln)
+
+        def fwd(b, _sl=sl, _d=dim):
+            idx = tuple([slice(None)] * _d + [_sl])
+            return b[idx]
+
+        def bwd(b, v, _sl=sl, _d=dim):
+            idx = tuple([slice(None)] * _d + [_sl])
+            return b.at[idx].set(v.astype(b.dtype))
+
+        lenses.append((fwd, bwd))
+        start += ln
+    return lenses
+
+
+@_reg("aten.split.Tensor", "multiview")
+def _split(ctx, base_shape, split_size, dim=0, **kw):
+    """torch.split/chunk: several aliasing views of one base — one
+    (fwd, bwd) slice lens per output piece (multiview kind)."""
+    if dim < 0:
+        dim += len(base_shape)
+    n = base_shape[dim]
+    if n == 0 or split_size == 0:
+        # torch's piece COUNT for empty dims is not derivable from
+        # (n, split_size) alone (chunk on an empty dim records
+        # split_size=0 yet returns num_chunks pieces) — reject loudly
+        # rather than silently diverge.
+        raise NotImplementedError(
+            f"aten.split over an empty dim (n={n}, split_size={split_size}) "
+            f"has no JAX lowering; materialize with the eager torch target."
+        )
+    return _slice_lenses(
+        [min(split_size, n - s) for s in range(0, n, split_size)], dim
+    )
+
+
+@_reg("aten.split_with_sizes.default", "multiview")
+def _split_with_sizes(ctx, base_shape, sizes, dim=0, **kw):
+    if dim < 0:
+        dim += len(base_shape)
+    return _slice_lenses(sizes, dim)
+
+
 @_reg("aten.squeeze.dim", "view")
 def _squeeze(ctx, base_shape, dim, **kw):
     if dim < 0:
