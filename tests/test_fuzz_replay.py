@@ -46,7 +46,7 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
     while len(steps) < N_OPS:
         kind = rng.choice(
             ["full", "arange", "view", "inplace_scalar", "inplace_binary",
-             "outofplace", "clone"]
+             "outofplace", "clone", "cat", "cast"]
             + (["uniform_"] if allow_rng_ops else [])
             + (["set_data", "data_read", "deepcopy", "value_read"]
                if allow_data_ops else [])
@@ -141,6 +141,21 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
             elif kind == "clone":
                 i = rng.randrange(len(pool))
                 emit((kind, i), pool[i].clone())
+            elif kind == "cat":
+                i = rng.randrange(len(pool))
+                cands = [
+                    j for j, t in enumerate(pool)
+                    if t.dim() == pool[i].dim() and t.dim() >= 1
+                    and t.shape[1:] == pool[i].shape[1:]
+                ]
+                if not cands:
+                    continue
+                j = rng.choice(cands)
+                emit((kind, i, j), torch.cat([pool[i], pool[j]], 0))
+            elif kind == "cast":
+                i = rng.randrange(len(pool))
+                dt = rng.choice([torch.float64, torch.float32])
+                emit((kind, i, str(dt)), pool[i].to(dt))
             elif kind == "uniform_":
                 i = rng.randrange(len(pool))
                 pool[i].uniform_(-1.0, 1.0)
@@ -156,6 +171,7 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                     # layout-relevant strides only, with the SAME
                     # predicate _set_data's guard applies
                     if t.shape == pool[i].shape
+                    and t.dtype == pool[i].dtype
                     and _effective_strides(t) == _effective_strides(pool[i])
                     and t is not pool[i]
                 ]
@@ -225,6 +241,12 @@ def run(steps):
             pool.append(getattr(pool[i], op)(v) if v is not None else getattr(pool[i], op)())
         elif kind == "clone":
             pool.append(pool[step[1]].clone())
+        elif kind == "cat":
+            _, i, j = step
+            pool.append(torch.cat([pool[i], pool[j]], 0))
+        elif kind == "cast":
+            _, i, dt = step
+            pool.append(pool[i].to(getattr(torch, dt.split(".")[-1])))
         elif kind == "uniform_":
             pool[step[1]].uniform_(-1.0, 1.0)
             pool.append(pool[step[1]])
