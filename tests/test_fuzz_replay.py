@@ -305,14 +305,19 @@ def test_single_tensor_replay_matches_eager(seed):
 def test_jax_bridge_replay_matches_eager(seed):
     # The jax-bridge compiler interprets the same graphs with Box/ViewBox
     # alias lenses; deterministic programs must produce identical values.
+    _jax_bridge_oracle(seed, allow_data_ops=False)
+
+
+def _jax_bridge_oracle(seed, *, allow_data_ops):
+    """Shared oracle: deterministic program → jax-bridge values == eager."""
     from torchdistx_tpu.jax_bridge import materialize_params_jax
 
-    steps = _gen_program(random.Random(seed), allow_rng_ops=False)
+    steps = _gen_program(
+        random.Random(seed), allow_rng_ops=False, allow_data_ops=allow_data_ops
+    )
     eager = run(steps)
     fakes = deferred_init(run, steps)
-    wanted = {
-        str(k): t for k, t in enumerate(fakes) if is_fake(t)
-    }
+    wanted = {str(k): t for k, t in enumerate(fakes) if is_fake(t)}
     try:
         arrays = materialize_params_jax(wanted, seed=0)
     except NotImplementedError as e:
@@ -321,6 +326,29 @@ def test_jax_bridge_replay_matches_eager(seed):
         assert np.array_equal(
             eager[int(k)].numpy(), np.asarray(arr)
         ), f"seed={seed} pool[{k}] {steps}"
+
+
+@pytest.mark.parametrize("seed", range(5 * N_PROGRAMS, 5 * N_PROGRAMS + 16))
+def test_jax_bridge_data_ops_match_eager(seed):
+    # Adds .data reads/writes, deepcopy, and value reads to the jax-bridge
+    # oracle: value reads early-materialize whole VIEW CHAINS, and later
+    # recorded in-place ops must write through the cached constants'
+    # alias structure (shared per-storage root boxes in _const_box).
+    _jax_bridge_oracle(seed, allow_data_ops=True)
+
+
+@pytest.mark.parametrize(
+    "seed", [100027, 100031, 100063, 100095, 100211, 100791, 101043]
+)
+def test_soak_regression_jax_bridge_materialized_aliases(seed):
+    # Round-2 soak regression (40k programs): an early-materialized view
+    # chain entered the JAX program as INDEPENDENT constant boxes, so a
+    # later recorded in-place op through one cached view left every other
+    # alias (including the base) stale.  Constants sharing a torch storage
+    # now share one flat root box behind per-view lenses, and components
+    # touching the same materialized storage are interpreted together in
+    # chronological order.
+    _jax_bridge_oracle(seed, allow_data_ops=True)
 
 
 @pytest.mark.parametrize("seed", range(2 * N_PROGRAMS, 3 * N_PROGRAMS))

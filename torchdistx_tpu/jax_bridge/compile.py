@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import torch
 
 from .._graph import CONTEXT_KEY, OpNode, get_fake_context
@@ -125,7 +126,7 @@ def _dep_box(node, idx, env) -> Box:
     box = env.get((id(node), idx))
     if box is None:
         if node.materialized and node.outputs is not None:
-            box = Box(jnp.asarray(to_numpy(node.outputs[idx])))
+            box = _const_box(node.outputs[idx], env)
             env[(id(node), idx)] = box
         else:
             raise KeyError(
@@ -133,6 +134,87 @@ def _dep_box(node, idx, env) -> Box:
                 f"interpreted before its dependent"
             )
     return box
+
+
+# Early-materialized nodes enter the JAX program as constants — but their
+# cached torch outputs can ALIAS each other (a value read materializes a
+# whole view chain, and later *recorded* in-place ops may write through any
+# of its members).  Independent constant boxes would break that coupling:
+# the write lands in one box and every other alias keeps the stale value.
+# So constants sharing a torch storage share ONE flat root box, and each
+# cached output becomes a ViewBox whose lens is rebuilt from its torch
+# geometry (size/stride/storage_offset) — the functional equivalent of the
+# reference replaying in-place ops against real aliasing tensors.
+_ROOTS_KEY = "_tdx_const_roots"
+
+
+def _storage_key(t: torch.Tensor):
+    s = t.untyped_storage()
+    return (s.data_ptr(), s.nbytes())
+
+
+def _view_lens(t: torch.Tensor):
+    """(fwd, bwd) index lenses mapping a flat storage array to the logical
+    value of ``t`` and back (gather / scatter by strided indices).
+
+    The common case — a contiguous tensor spanning its whole storage —
+    is a free reshape; anything strided pays a baked index array."""
+    size = tuple(t.shape)
+    if (
+        t.storage_offset() == 0
+        and t.is_contiguous()
+        and t.numel() * t.element_size() == t.untyped_storage().nbytes()
+    ):
+        return (lambda flat: flat.reshape(size),
+                lambda flat, value: value.reshape(flat.shape))
+
+    stride = tuple(t.stride())
+    idx = np.full(size, t.storage_offset(), dtype=np.int64)
+    for d in range(len(size)):
+        sh = [1] * len(size)
+        sh[d] = size[d]
+        idx = idx + np.arange(size[d], dtype=np.int64).reshape(sh) * stride[d]
+    if idx.size == 0 or int(idx.max()) < 2**31:
+        idx = idx.astype(np.int32)  # avoid x64 truncation warnings
+
+    def fwd(flat):
+        return flat[idx]
+
+    def bwd(flat, value):
+        return flat.at[idx].set(value)
+
+    return fwd, bwd
+
+
+def _const_box(out: torch.Tensor, env) -> Box:
+    """A box for one early-materialized constant, alias-linked through a
+    shared per-storage root so recorded in-place ops through any cached
+    view stay visible to every other alias."""
+    s = out.untyped_storage()
+    if s.nbytes() == 0 or s.nbytes() % out.element_size() != 0:
+        return Box(jnp.asarray(to_numpy(out)))
+    roots = env.setdefault(_ROOTS_KEY, {})
+    key = _storage_key(out)
+    entry = roots.get(key)
+    if entry is None:
+        flat = torch.empty(0, dtype=out.dtype)
+        flat.set_(s)  # 1-D tensor spanning the whole storage
+        entry = (out.dtype, Box(jnp.asarray(to_numpy(flat))))
+        roots[key] = entry
+    root_dtype, root_box = entry
+    if out.dtype != root_dtype:
+        # Mixed-dtype views of one storage (e.g. view_as_real of a complex
+        # base): no lens over the typed root, and an UNLINKED constant
+        # would silently reintroduce the stale-alias bug — refuse, like
+        # every other unsupported construct in the bridge.
+        raise NotImplementedError(
+            f"early-materialized constants alias one storage with mixed "
+            f"dtypes ({root_dtype} vs {out.dtype}); the JAX bridge cannot "
+            f"alias-link them. Materialize these tensors with the eager "
+            f"torch ReplayTarget instead."
+        )
+    fwd, bwd = _view_lens(out)
+    return ViewBox(root_box, fwd, bwd)
 
 
 def _resolve_value(obj, env, deps):
@@ -168,10 +250,10 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
     if node.materialized and node.outputs is not None:
         # Terminal ops (aten::item) force early torch materialization during
         # recording (deferred_init.cc:792-797); their results enter the JAX
-        # program as constants.
+        # program as constants (alias-linked — see _const_box).
         for i, out in enumerate(node.outputs):
             if isinstance(out, torch.Tensor):
-                env[(id(node), i)] = Box(jnp.asarray(to_numpy(out)))
+                env.setdefault((id(node), i), _const_box(out, env))
         return
 
     name = _op_name(node)
@@ -303,12 +385,34 @@ def _components(nodes: Sequence[OpNode]) -> List[List[OpNode]]:
             x = parent[x]
         return x
 
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    # Components touching the same early-materialized STORAGE must stay
+    # together: their constants alias through one shared root box (see
+    # _const_box), so a recorded in-place write in one component is visible
+    # to readers in the other — chronological interleaving (and never
+    # batching them apart) is required for correctness.
+    storage_anchor: Dict[Any, int] = {}
+
+    def union_storage(nid: int, out) -> None:
+        if not isinstance(out, torch.Tensor) or out.untyped_storage().nbytes() == 0:
+            return
+        key = _storage_key(out)
+        a = storage_anchor.setdefault(key, nid)
+        union(nid, a)
+
     for n in nodes:
-        for d, _ in n.dependencies:
+        if n.materialized and n.outputs is not None:
+            for out in n.outputs:
+                union_storage(id(n), out)
+        for d, idx in n.dependencies:
             if id(d) in parent:
-                a, b = find(id(n)), find(id(d))
-                if a != b:
-                    parent[a] = b
+                union(id(n), id(d))
+            elif d.materialized and d.outputs is not None and idx < len(d.outputs):
+                union_storage(id(n), d.outputs[idx])
     comps: Dict[int, List[OpNode]] = {}
     for n in nodes:  # already in op_nr order
         comps.setdefault(find(id(n)), []).append(n)
