@@ -47,7 +47,7 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
         kind = rng.choice(
             ["full", "arange", "view", "inplace_scalar", "inplace_binary",
              "outofplace", "clone", "cat", "cast"]
-            + (["uniform_"] if allow_rng_ops else [])
+            + (["uniform_", "normal_"] if allow_rng_ops else [])
             + (["set_data", "data_read", "deepcopy", "value_read"]
                if allow_data_ops else [])
         )
@@ -86,16 +86,18 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                         continue
                     emit((kind, i, op, 2), base.expand(2, *base.shape[1:]))
                 elif op == "select":
-                    if base.dim() < 1 or base.shape[0] < 1:
+                    d = rng.choice([dd for dd in range(base.dim()) if base.shape[dd] >= 1] or [None])
+                    if d is None:
                         continue
-                    j = rng.randrange(base.shape[0])
-                    emit((kind, i, op, j), base.select(0, j))
+                    j = rng.randrange(base.shape[d])
+                    emit((kind, i, op, (d, j)), base.select(d, j))
                 elif op == "narrow":
-                    if base.dim() < 1 or base.shape[0] < 2:
+                    d = rng.choice([dd for dd in range(base.dim()) if base.shape[dd] >= 2] or [None])
+                    if d is None:
                         continue
-                    s = rng.randrange(base.shape[0] - 1)
-                    ln = rng.randrange(1, base.shape[0] - s + 1)
-                    emit((kind, i, op, (s, ln)), base.narrow(0, s, ln))
+                    s = rng.randrange(base.shape[d] - 1)
+                    ln = rng.randrange(1, base.shape[d] - s + 1)
+                    emit((kind, i, op, (d, s, ln)), base.narrow(d, s, ln))
                 elif op == "transpose":
                     if base.dim() < 2:
                         continue
@@ -126,14 +128,14 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
                 if not cands:
                     continue
                 j = rng.choice(cands)
-                op = rng.choice(["add_", "mul_"])
+                op = rng.choice(["add_", "mul_", "copy_"])
                 getattr(pool[i], op)(pool[j])
                 steps.append((kind, i, j, op))
                 pool.append(pool[i])
             elif kind == "outofplace":
                 i = rng.randrange(len(pool))
-                op = rng.choice(["mul", "add", "neg", "abs"])
-                if op in ("mul", "add"):
+                op = rng.choice(["mul", "add", "sub", "div", "neg", "abs"])
+                if op in ("mul", "add", "sub", "div"):
                     v = float(rng.randint(1, 3))
                     emit((kind, i, op, v), getattr(pool[i], op)(v))
                 else:
@@ -159,6 +161,11 @@ def _gen_program(rng: random.Random, *, allow_rng_ops: bool,
             elif kind == "uniform_":
                 i = rng.randrange(len(pool))
                 pool[i].uniform_(-1.0, 1.0)
+                steps.append((kind, i))
+                pool.append(pool[i])
+            elif kind == "normal_":
+                i = rng.randrange(len(pool))
+                pool[i].normal_(0.0, 1.0)
                 steps.append((kind, i))
                 pool.append(pool[i])
             elif kind == "set_data":
@@ -215,9 +222,9 @@ def run(steps):
             _, i, op, arg = step
             base = pool[i]
             if op == "select":
-                pool.append(base.select(0, arg))
+                pool.append(base.select(*arg))
             elif op == "narrow":
-                pool.append(base.narrow(0, *arg))
+                pool.append(base.narrow(*arg))
             elif op == "transpose":
                 pool.append(base.transpose(0, 1))
             elif op == "unsqueeze":
@@ -249,6 +256,9 @@ def run(steps):
             pool.append(pool[i].to(getattr(torch, dt.split(".")[-1])))
         elif kind == "uniform_":
             pool[step[1]].uniform_(-1.0, 1.0)
+            pool.append(pool[step[1]])
+        elif kind == "normal_":
+            pool[step[1]].normal_(0.0, 1.0)
             pool.append(pool[step[1]])
         elif kind == "set_data":
             _, i, j = step

@@ -22,7 +22,6 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import torch
 
 from .._graph import CONTEXT_KEY, OpNode, get_fake_context
@@ -155,10 +154,11 @@ def _storage_key(t: torch.Tensor):
 
 def _view_lens(t: torch.Tensor):
     """(fwd, bwd) index lenses mapping a flat storage array to the logical
-    value of ``t`` and back (gather / scatter by strided indices).
+    value of ``t`` and back, from its torch geometry.
 
     The common case — a contiguous tensor spanning its whole storage —
-    is a free reshape; anything strided pays a baked index array."""
+    is a free reshape; anything strided uses the shared flat strided
+    lens (ops.strided_lens, same code path as aten.as_strided)."""
     size = tuple(t.shape)
     if (
         t.storage_offset() == 0
@@ -168,22 +168,9 @@ def _view_lens(t: torch.Tensor):
         return (lambda flat: flat.reshape(size),
                 lambda flat, value: value.reshape(flat.shape))
 
-    stride = tuple(t.stride())
-    idx = np.full(size, t.storage_offset(), dtype=np.int64)
-    for d in range(len(size)):
-        sh = [1] * len(size)
-        sh[d] = size[d]
-        idx = idx + np.arange(size[d], dtype=np.int64).reshape(sh) * stride[d]
-    if idx.size == 0 or int(idx.max()) < 2**31:
-        idx = idx.astype(np.int32)  # avoid x64 truncation warnings
+    from .ops import strided_lens
 
-    def fwd(flat):
-        return flat[idx]
-
-    def bwd(flat, value):
-        return flat.at[idx].set(value)
-
-    return fwd, bwd
+    return strided_lens(size, t.stride(), t.storage_offset())
 
 
 def _const_box(out: torch.Tensor, env) -> Box:

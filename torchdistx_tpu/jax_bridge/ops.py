@@ -465,15 +465,16 @@ def _alias_view(ctx, base_shape, **kw):
     return (lambda b: b), (lambda b, v: v)
 
 
-@_reg("aten.as_strided.default", "view")
-def _as_strided(ctx, base_shape, size, stride, storage_offset=None, **kw):
-    # General strided view as a flat gather (fwd) / scatter (bwd).  Used
-    # by FakeTensor.__deepcopy__'s storage-copy protocol; overlapping
-    # strides write last-wins in bwd, matching in-place-through-view
-    # replay on disjoint views (the only recorded use).
+def strided_lens(size, stride, offset):
+    """(fwd, bwd) lenses between a FLAT array and the strided view
+    described by torch (size, stride, storage_offset): flat gather (fwd)
+    / scatter (bwd).  Overlapping strides write last-wins in bwd,
+    matching in-place-through-view replay on disjoint views (the only
+    recorded use).  Shared by aten.as_strided and the compiler's
+    alias-linked constants (compile._view_lens)."""
     size = tuple(int(s) for s in size)
     stride = tuple(int(s) for s in stride)
-    offset = int(storage_offset or 0)
+    offset = int(offset)
 
     def _indices():
         idx = jnp.asarray(offset, jnp.int32)
@@ -483,12 +484,27 @@ def _as_strided(ctx, base_shape, size, stride, storage_offset=None, **kw):
             idx = idx + (jnp.arange(s, dtype=jnp.int32) * st).reshape(shape)
         return idx
 
+    def fwd(flat):
+        return flat[_indices()]
+
+    def bwd(flat, v):
+        return flat.at[_indices()].set(v)
+
+    return fwd, bwd
+
+
+@_reg("aten.as_strided.default", "view")
+def _as_strided(ctx, base_shape, size, stride, storage_offset=None, **kw):
+    # General strided view over a base of any shape: ravel, then the
+    # shared flat strided lens.  Used by FakeTensor.__deepcopy__'s
+    # storage-copy protocol.
+    flat_fwd, flat_bwd = strided_lens(size, stride, storage_offset or 0)
+
     def fwd(b):
-        return jnp.ravel(b)[_indices()]
+        return flat_fwd(jnp.ravel(b))
 
     def bwd(b, v):
-        flat = jnp.ravel(b).at[_indices()].set(v)
-        return flat.reshape(b.shape)
+        return flat_bwd(jnp.ravel(b), v).reshape(b.shape)
 
     return fwd, bwd
 
