@@ -319,21 +319,53 @@ PHASES = {
 }
 
 
-def _run_phase(name: str, timeout: float = 600.0):
+BCACHE_DIR = os.path.join(REPO, ".bench_cache")
+
+
+def _cache_path(name: str) -> str:
+    return os.path.join(BCACHE_DIR, f"{name}.json")
+
+
+def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
+    """Run one phase in a subprocess.  With ``cache_fallback`` (hardware
+    phases only), a failed run — the axon tunnel wedges for hours at a
+    time — reports the last successful measurement instead, honestly
+    labeled with its age via ``stale_s``.  The headline phases never use
+    the cache: the scoreboard number is always freshly measured."""
+    err = None
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--phase", name],
             capture_output=True, text=True, cwd=REPO, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"phase {name} timed out after {timeout:.0f}s",
-                "timeout_s": timeout}
-    if res.returncode != 0:
-        return {"error": (res.stderr or res.stdout).strip()[-400:]}
-    try:
-        return json.loads(res.stdout.strip().splitlines()[-1])
-    except Exception:
-        return {"error": f"unparseable phase output: {res.stdout[-200:]!r}"}
+        err = {"error": f"phase {name} timed out after {timeout:.0f}s",
+               "timeout_s": timeout}
+    if err is None and res.returncode != 0:
+        err = {"error": (res.stderr or res.stdout).strip()[-400:]}
+    if err is None:
+        try:
+            parsed = json.loads(res.stdout.strip().splitlines()[-1])
+        except Exception:
+            err = {"error": f"unparseable phase output: {res.stdout[-200:]!r}"}
+    if err is None:
+        try:
+            os.makedirs(BCACHE_DIR, exist_ok=True)
+            with open(_cache_path(name), "w") as f:
+                json.dump({"ts": time.time(), "result": parsed}, f)
+        except OSError:
+            pass
+        return parsed
+    if cache_fallback:
+        try:
+            with open(_cache_path(name)) as f:
+                cached = json.load(f)
+            return {**cached["result"],
+                    "stale_s": round(time.time() - cached["ts"]),
+                    "fresh_run_error": err["error"][-160:]}
+        except (OSError, KeyError, ValueError):
+            pass
+    return err
 
 
 def _preflight_platform() -> str:
@@ -396,18 +428,22 @@ def main() -> None:
         # (virtual-mesh sharded configs, host-side 70B lowering).
         out["llama_skipped"] = out["flash_skipped"] = "accelerator unavailable"
     else:
-        llama_ours = _run_phase("llama_ours")
+        llama_ours = _run_phase("llama_ours", cache_fallback=True)
         if "error" not in llama_ours:
-            llama_base = _run_phase("llama_baseline")
+            llama_base = _run_phase("llama_baseline", cache_fallback=True)
             out["llama_1p9b_ours_s"] = round(llama_ours["t"], 3)
             out["llama_1p9b_ours_rss_mb"] = round(llama_ours["rss_mb"], 1)
             out["llama_1p9b_n_params"] = llama_ours.get("n_params")
+            if "stale_s" in llama_ours:
+                out["llama_1p9b_stale_s"] = llama_ours["stale_s"]
             if "error" not in llama_base:
                 out["llama_1p9b_baseline_s"] = round(llama_base["t"], 3)
                 out["llama_1p9b_baseline_rss_mb"] = round(llama_base["rss_mb"], 1)
                 out["llama_1p9b_vs_baseline"] = round(
                     llama_base["t"] / llama_ours["t"], 3
                 )
+                if "stale_s" in llama_base:
+                    out["llama_1p9b_baseline_stale_s"] = llama_base["stale_s"]
             elif "timeout_s" in llama_base:
                 # The eager path (torch CPU init of 1.5B params + 5.9 GB
                 # of host→device transfers) did not finish inside the
@@ -439,7 +475,7 @@ def main() -> None:
         out["llama70b_error"] = b70["error"][-160:]
 
     if not fallback:
-        flash = _run_phase("flash", timeout=480.0)
+        flash = _run_phase("flash", timeout=480.0, cache_fallback=True)
         if "error" not in flash:
             out.update({
                 f"flash_{k}" if not k.startswith(("flash", "ref")) else k: v

@@ -475,20 +475,22 @@ def strided_lens(size, stride, offset):
     size = tuple(int(s) for s in size)
     stride = tuple(int(s) for s in stride)
     offset = int(offset)
+    # int32 covers every index unless the storage is >= 2^31 elements
+    # (a 70B-scale embedding view); int64 there needs jax_enable_x64.
+    top = offset + sum((s - 1) * st for s, st in zip(size, stride) if s > 0)
+    dt = jnp.int32 if top < 2**31 else jnp.int64
 
-    def _indices():
-        idx = jnp.asarray(offset, jnp.int32)
-        for dim, (s, st) in enumerate(zip(size, stride)):
-            shape = [1] * len(size)
-            shape[dim] = s
-            idx = idx + (jnp.arange(s, dtype=jnp.int32) * st).reshape(shape)
-        return idx
+    idx = jnp.asarray(offset, dt)
+    for dim, (s, st) in enumerate(zip(size, stride)):
+        shape = [1] * len(size)
+        shape[dim] = s
+        idx = idx + (jnp.arange(s, dtype=dt) * st).reshape(shape)
 
     def fwd(flat):
-        return flat[_indices()]
+        return flat[idx]
 
     def bwd(flat, v):
-        return flat.at[_indices()].set(v)
+        return flat.at[idx].set(v)
 
     return fwd, bwd
 
