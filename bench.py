@@ -444,6 +444,10 @@ def main() -> None:
                 )
                 if "stale_s" in llama_base:
                     out["llama_1p9b_baseline_stale_s"] = llama_base["stale_s"]
+                if ("stale_s" in llama_base) != ("stale_s" in llama_ours):
+                    # One side cached, the other fresh: the ratio never
+                    # occurred in a single session — say so.
+                    out["llama_1p9b_vs_baseline_mixed_sessions"] = True
             elif "timeout_s" in llama_base:
                 # The eager path (torch CPU init of 1.5B params + 5.9 GB
                 # of host→device transfers) did not finish inside the

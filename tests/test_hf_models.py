@@ -1,5 +1,8 @@
-"""End-to-end coverage for the HF model families named in BASELINE.json:
-GPT-2, Llama, Mixtral, T5 — deferred_init → {torch replay, JAX materialize}.
+"""End-to-end coverage for HF model families: the four named in
+BASELINE.json (GPT-2, Llama, Mixtral, T5) plus eleven more architectures
+(encoder-only, encoder-decoder, vision, audio, multimodal dual-tower,
+alibi/rope/learned-position decoder variants) — deferred_init →
+{torch replay with eager bitwise parity, JAX materialize}.
 """
 
 import numpy as np
@@ -27,6 +30,24 @@ def _cases():
     from transformers import (
         BertConfig,
         BertModel,
+        BloomConfig,
+        BloomForCausalLM,
+        CLIPConfig,
+        CLIPModel,
+        CLIPTextConfig,
+        CLIPVisionConfig,
+        FalconConfig,
+        FalconForCausalLM,
+        GemmaConfig,
+        GemmaForCausalLM,
+        GPTNeoXConfig,
+        GPTNeoXForCausalLM,
+        OPTConfig,
+        OPTForCausalLM,
+        PhiConfig,
+        PhiForCausalLM,
+        Qwen2Config,
+        Qwen2ForCausalLM,
         ViTConfig,
         ViTModel,
         WhisperConfig,
@@ -34,6 +55,56 @@ def _cases():
     )
 
     return {
+        "gpt_neox": (
+            GPTNeoXForCausalLM,
+            GPTNeoXConfig(hidden_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, intermediate_size=128,
+                          vocab_size=256),
+        ),
+        "falcon": (
+            FalconForCausalLM,
+            FalconConfig(hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, vocab_size=256),
+        ),
+        "clip": (  # dual-tower multimodal: two embeddings + logit_scale scalar
+            CLIPModel,
+            CLIPConfig.from_text_vision_configs(
+                CLIPTextConfig(hidden_size=64, num_hidden_layers=2,
+                               num_attention_heads=2, vocab_size=256,
+                               intermediate_size=128),
+                CLIPVisionConfig(hidden_size=64, num_hidden_layers=2,
+                                 num_attention_heads=2, image_size=32,
+                                 patch_size=8, intermediate_size=128),
+            ),
+        ),
+        "gemma": (
+            GemmaForCausalLM,
+            GemmaConfig(hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        intermediate_size=128, vocab_size=256, head_dim=16),
+        ),
+        "qwen2": (
+            Qwen2ForCausalLM,
+            Qwen2Config(hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        intermediate_size=128, vocab_size=256),
+        ),
+        "phi": (
+            PhiForCausalLM,
+            PhiConfig(hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      vocab_size=256),
+        ),
+        "opt": (
+            OPTForCausalLM,
+            OPTConfig(hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, ffn_dim=128, vocab_size=256,
+                      word_embed_proj_dim=64),
+        ),
+        "bloom": (
+            BloomForCausalLM,
+            BloomConfig(hidden_size=64, n_layer=2, n_head=4, vocab_size=256),
+        ),
         "gpt2": (GPT2LMHeadModel, GPT2Config(n_layer=2, n_embd=64, n_head=4, vocab_size=256)),
         "bert": (
             BertModel,
@@ -112,7 +183,13 @@ def test_eager_parity_llama():
         assert torch.equal(p1, p2), n1
 
 
-@pytest.mark.parametrize("name", ["bert", "vit", "whisper"])
+EXTRA_FAMILIES = [
+    "bert", "vit", "whisper", "gpt_neox", "falcon", "clip", "gemma",
+    "qwen2", "phi", "opt", "bloom",
+]
+
+
+@pytest.mark.parametrize("name", EXTRA_FAMILIES)
 def test_eager_parity_extra_families(name):
     # ViT in particular: HF's trunc_normal_ idiom is rejection sampling
     # with data-dependent loops; parity requires control-flow-forced
@@ -131,7 +208,7 @@ def test_eager_parity_extra_families(name):
         assert torch.equal(p1, p2), n1
 
 
-@pytest.mark.parametrize("name", ["bert", "vit", "whisper"])
+@pytest.mark.parametrize("name", EXTRA_FAMILIES)
 def test_extra_families_jax_materialize(name):
     cls, cfg = _cases()[name]
     m = deferred_init(cls, cfg)

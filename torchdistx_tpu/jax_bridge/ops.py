@@ -476,21 +476,37 @@ def strided_lens(size, stride, offset):
     stride = tuple(int(s) for s in stride)
     offset = int(offset)
     # int32 covers every index unless the storage is >= 2^31 elements
-    # (a 70B-scale embedding view); int64 there needs jax_enable_x64.
+    # (a 70B-scale embedding view); int64 there requires jax_enable_x64 —
+    # without it jnp silently truncates back to int32 and the gather
+    # would wrap, so fail loudly instead.
     top = offset + sum((s - 1) * st for s, st in zip(size, stride) if s > 0)
+    if top >= 2**31 and not jax.config.jax_enable_x64:
+        raise NotImplementedError(
+            f"strided view tops {top} storage elements (>= 2^31); enable "
+            f"jax_enable_x64 for int64 gather/scatter indices."
+        )
     dt = jnp.int32 if top < 2**31 else jnp.int64
 
-    idx = jnp.asarray(offset, dt)
-    for dim, (s, st) in enumerate(zip(size, stride)):
-        shape = [1] * len(size)
-        shape[dim] = s
-        idx = idx + (jnp.arange(s, dtype=dt) * st).reshape(shape)
+    # Lazily memoized: computed at most once per lens (lenses live only
+    # within one interpretation/trace), never for lenses that are built
+    # but never read or written.
+    cache: list = []
+
+    def _idx():
+        if not cache:
+            idx = jnp.asarray(offset, dt)
+            for dim, (s, st) in enumerate(zip(size, stride)):
+                shape = [1] * len(size)
+                shape[dim] = s
+                idx = idx + (jnp.arange(s, dtype=dt) * st).reshape(shape)
+            cache.append(idx)
+        return cache[0]
 
     def fwd(flat):
-        return flat[idx]
+        return flat[_idx()]
 
     def bwd(flat, v):
-        return flat.at[idx].set(v)
+        return flat.at[_idx()].set(v)
 
     return fwd, bwd
 
