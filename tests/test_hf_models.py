@@ -15,21 +15,10 @@ from torchdistx_tpu.jax_bridge import materialize_module_jax
 from torchdistx_tpu.parallel import fsdp_plan, make_mesh
 
 
-def _cases():
+def _newer_cases():
+    """The families only newer transformers releases provide; raises
+    ImportError as a unit when the installed release predates them."""
     from transformers import (
-        GPT2Config,
-        GPT2LMHeadModel,
-        LlamaConfig,
-        LlamaForCausalLM,
-        MixtralConfig,
-        MixtralForCausalLM,
-        T5Config,
-        T5ForConditionalGeneration,
-    )
-
-    from transformers import (
-        BertConfig,
-        BertModel,
         BloomConfig,
         BloomForCausalLM,
         CLIPConfig,
@@ -48,10 +37,6 @@ def _cases():
         PhiForCausalLM,
         Qwen2Config,
         Qwen2ForCausalLM,
-        ViTConfig,
-        ViTModel,
-        WhisperConfig,
-        WhisperModel,
     )
 
     return {
@@ -105,6 +90,40 @@ def _cases():
             BloomForCausalLM,
             BloomConfig(hidden_size=64, n_layer=2, n_head=4, vocab_size=256),
         ),
+    }
+
+
+def _cases():
+    from transformers import (
+        GPT2Config,
+        GPT2LMHeadModel,
+        LlamaConfig,
+        LlamaForCausalLM,
+        MixtralConfig,
+        MixtralForCausalLM,
+        T5Config,
+        T5ForConditionalGeneration,
+    )
+
+    from transformers import (
+        BertConfig,
+        BertModel,
+        ViTConfig,
+        ViTModel,
+        WhisperConfig,
+        WhisperModel,
+    )
+
+    try:
+        newer = _newer_cases()
+    except ImportError:
+        # Newer architectures absent on older transformers: their
+        # families are simply not offered (tests skip via NEWER_FAMILIES
+        # guards); the baseline families below stay unaffected.
+        newer = {}
+
+    return {
+        **newer,
         "gpt2": (GPT2LMHeadModel, GPT2Config(n_layer=2, n_embd=64, n_head=4, vocab_size=256)),
         "bert": (
             BertModel,
@@ -195,7 +214,10 @@ def test_eager_parity_extra_families(name):
     # with data-dependent loops; parity requires control-flow-forced
     # early materialization to replay pending RNG draws in recorded
     # order (_graph.flush_pending_rng).
-    cls, cfg = _cases()[name]
+    cases = _cases()
+    if name not in cases:
+        pytest.skip("family requires a newer transformers release")
+    cls, cfg = cases[name]
     torch.manual_seed(5)
     eager = cls(cfg)
     torch.manual_seed(5)
@@ -210,7 +232,10 @@ def test_eager_parity_extra_families(name):
 
 @pytest.mark.parametrize("name", EXTRA_FAMILIES)
 def test_extra_families_jax_materialize(name):
-    cls, cfg = _cases()[name]
+    cases = _cases()
+    if name not in cases:
+        pytest.skip("family requires a newer transformers release")
+    cls, cfg = cases[name]
     m = deferred_init(cls, cfg)
     params = materialize_module_jax(m, seed=0)
     for k, v in params.items():

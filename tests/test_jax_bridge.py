@@ -470,3 +470,38 @@ class TestMultiOutputViews:
         arrays = materialize_params_jax(dict(fakes), seed=0)
         for k, t in eager.items():
             np.testing.assert_array_equal(t.numpy(), np.asarray(arrays[k]))
+
+
+class TestExternalConstantDtypes:
+    def test_bf16_external_tensor_stays_bf16(self):
+        # An external bf16 tensor entering the recording must become a
+        # bf16 constant (to_numpy routes through ml_dtypes.bfloat16): an
+        # f32 constant would silently change downstream arithmetic —
+        # bf16 + bf16 rounds at 8 mantissa bits, f32 + f32 at 24.
+        import torch
+
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.fake import is_fake
+        from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+        torch.manual_seed(0)
+        ext = torch.randn(4, 3, dtype=torch.bfloat16)
+
+        def build():
+            a = torch.full((4, 3), 2.0, dtype=torch.bfloat16)
+            b = a + ext
+            return a, b, b.float()
+
+        eager = build()
+        fakes = deferred_init(build)
+        arrays = materialize_params_jax(
+            {str(i): t for i, t in enumerate(fakes) if is_fake(t)}, seed=0
+        )
+        import numpy as np
+
+        for k, arr in arrays.items():
+            e = eager[int(k)]
+            assert str(np.asarray(arr).dtype) == str(e.dtype).removeprefix("torch."), k
+            assert np.array_equal(
+                e.float().numpy(), np.asarray(arr, np.float32)
+            ), k

@@ -34,9 +34,14 @@ def jax_dtype(torch_dtype: torch.dtype):
 
 def to_numpy(t: torch.Tensor) -> np.ndarray:
     """Convert an external (real) torch tensor to numpy for use as a
-    compile-time constant."""
+    compile-time constant, preserving dtype."""
     t = t.detach().cpu()
     if t.dtype == torch.bfloat16:
-        # numpy has no bf16; round-trip through f32 (values preserved).
-        return t.to(torch.float32).numpy()
+        # stock numpy has no bf16: bitcast through uint16 into
+        # ml_dtypes.bfloat16 so jnp.asarray keeps the dtype — an f32
+        # constant would silently change downstream arithmetic (f32 add
+        # vs bf16 add round differently).
+        import ml_dtypes
+
+        return t.contiguous().view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
     return t.numpy()
