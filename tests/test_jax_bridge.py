@@ -505,3 +505,55 @@ class TestExternalConstantDtypes:
             assert np.array_equal(
                 e.float().numpy(), np.asarray(arr, np.float32)
             ), k
+
+
+class TestParamDtypePolicy:
+    def test_bf16_storage_f32_init(self):
+        # The standard TPU policy: init statistics computed at recorded
+        # (f32) precision, storage in bf16, cast fused into the compiled
+        # init program.  Values must equal the f32 materialization cast
+        # after the fact; integer buffers must be untouched.
+        import jax.numpy as jnp
+        import numpy as np
+        import torch
+
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.jax_bridge import materialize_module_jax
+
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(8, 4)
+                self.register_buffer("steps", torch.zeros(1, dtype=torch.int64))
+                # float BUFFER (RoPE inv_freq / batchnorm stats stand-in):
+                # must stay f32 under a bf16 param policy.
+                self.register_buffer("inv_freq", torch.ones(3) / 7.0)
+
+        m = deferred_init(M)
+        full = materialize_module_jax(m, seed=0)
+        half = materialize_module_jax(m, seed=0, param_dtype=jnp.bfloat16)
+        assert str(half["lin.weight"].dtype) == "bfloat16"
+        assert str(half["steps"].dtype).startswith("int")
+        assert str(half["inv_freq"].dtype) == "float32"
+        assert np.array_equal(
+            np.asarray(full["lin.weight"].astype(jnp.bfloat16), np.float32),
+            np.asarray(half["lin.weight"], np.float32),
+        )
+
+    def test_sharded_bf16_via_hf_wrapper(self):
+        import jax.numpy as jnp
+        from transformers import GPT2Config
+
+        from torchdistx_tpu.hf import deferred_init_from_config, materialize_sharded
+        from torchdistx_tpu.parallel import make_mesh
+
+        m = deferred_init_from_config(
+            GPT2Config(n_layer=2, n_embd=64, n_head=2, vocab_size=256)
+        )
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        params = materialize_sharded(
+            m, mesh, seed=0, min_shard_size=1024, param_dtype=jnp.bfloat16
+        )
+        w = params["transformer.wte.weight"]
+        assert str(w.dtype) == "bfloat16"
+        assert not w.sharding.is_fully_replicated

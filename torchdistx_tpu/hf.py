@@ -57,16 +57,21 @@ def materialize_sharded(
     plan=None,
     seed: int = 0,
     min_shard_size: int = 1 << 16,
+    param_dtype=None,
 ) -> Dict[str, Any]:
     """Compile the module's recording into (sharded) jax arrays.
 
     With a mesh and no plan, parameters above ``min_shard_size`` elements
     are FSDP-sharded along their largest divisible dim (the name-agnostic
-    plan — correct for any HF param naming scheme)."""
+    plan — correct for any HF param naming scheme).  ``param_dtype``
+    (e.g. ``jnp.bfloat16``) stores floating parameters at that precision,
+    cast inside the compiled init program."""
     from .jax_bridge import materialize_module_jax
 
     if mesh is not None and plan is None:
         from .parallel import fsdp_plan
 
         plan = fsdp_plan(min_size=min_shard_size)
-    return materialize_module_jax(module, mesh=mesh, plan=plan, seed=seed)
+    return materialize_module_jax(
+        module, mesh=mesh, plan=plan, seed=seed, param_dtype=param_dtype
+    )

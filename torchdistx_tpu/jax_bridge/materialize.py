@@ -70,6 +70,33 @@ def _maybe_enable_cache() -> None:
         _cache_enabled = True
 
 
+def _cast_outputs(init_fn, param_dtype, mask=None):
+    """Wrap ``init_fn`` so floating outputs are cast to ``param_dtype``
+    INSIDE the compiled program: the standard TPU policy — compute init
+    statistics in f32, store parameters in bf16 — with the cast fused by
+    XLA, so full-precision values never exist in device memory.
+
+    ``mask`` selects which outputs are eligible (module entry points pass
+    the is-an-``nn.Parameter`` mask: float BUFFERS like RoPE ``inv_freq``
+    or batchnorm running stats must keep full precision under a bf16
+    param policy).  Integer/bool outputs are never cast."""
+    if param_dtype is None:
+        return init_fn
+    import jax.numpy as jnp
+
+    def fn(key):
+        outs = init_fn(key)
+        sel = mask if mask is not None else [True] * len(outs)
+        return tuple(
+            o.astype(param_dtype)
+            if m and jnp.issubdtype(o.dtype, jnp.floating)
+            else o
+            for o, m in zip(outs, sel)
+        )
+
+    return fn
+
+
 def _run_init(init_fn, key, out_shardings=None):
     _maybe_enable_cache()
     if out_shardings is not None:
@@ -130,6 +157,7 @@ def materialize_params_jax(
     mesh: Optional[Mesh] = None,
     plan: Optional[ShardingPlan] = None,
     seed: int = 0,
+    param_dtype=None,
 ) -> Dict[str, jax.Array]:
     """Materialize a dict of fake tensors as (sharded) jax.Arrays.
 
@@ -138,8 +166,19 @@ def materialize_params_jax(
     ``NamedSharding``.  RNG uses per-op keys (fold_in of ``seed`` and the
     recorded op number), so results are independent of sharding layout and
     materialization order.
+
+    ``param_dtype`` (e.g. ``jnp.bfloat16``) casts floating
+    ``nn.Parameter`` entries inside the compiled program — init
+    statistics are computed at recorded precision, parameter storage is
+    ``param_dtype``, and the full-precision values never exist in device
+    memory.  Buffers (float or otherwise) keep their recorded dtype:
+    RoPE ``inv_freq`` / batchnorm running stats must stay full precision
+    under a bf16 param policy.
     """
     names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+    if param_dtype is not None:
+        mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+        init_fn = _cast_outputs(init_fn, param_dtype, mask)
     values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
     return dict(zip(names, values))
 
@@ -150,11 +189,17 @@ def materialize_tensor_jax(
     mesh: Optional[Mesh] = None,
     spec: Optional[PartitionSpec] = None,
     seed: int = 0,
+    param_dtype=None,
 ) -> jax.Array:
-    """Materialize one fake tensor as a (sharded) jax.Array."""
+    """Materialize one fake tensor as a (sharded) jax.Array.
+
+    ``param_dtype`` casts the result inside the compiled program when it
+    is floating — the tensor is named explicitly here, so no
+    parameter-vs-buffer distinction applies (unlike the module entry
+    points, which never cast buffers)."""
     if not is_fake(tensor):
         raise ValueError("`tensor` is not fake; nothing to materialize.")
-    init_fn = build_init_fn([tensor])
+    init_fn = _cast_outputs(build_init_fn([tensor]), param_dtype)
     out_shardings = None
     if mesh is not None:
         out_shardings = (NamedSharding(mesh, spec or PartitionSpec()),)
@@ -166,6 +211,7 @@ def lower_init_module(
     *,
     mesh: Optional[Mesh] = None,
     plan: Optional[ShardingPlan] = None,
+    param_dtype=None,
 ):
     """Trace and *lower* (without compiling or executing) the full sharded
     init program of a deferred-init module.
@@ -178,6 +224,11 @@ def lower_init_module(
     without ever holding a parameter — the step a reference
     (torchdistX) user has no counterpart for.
 
+    ``param_dtype`` changes the exported program's floating PARAMETER
+    output dtypes (buffers keep recorded precision), exactly as
+    :func:`materialize_module_jax` would — an exported program and a live
+    materialization with the same policy produce the same dtypes.
+
     The PRNG key is a *runtime argument* of the program, not baked in:
     pass it when executing, e.g.
     ``lowered.compile(compiler_options={"exec_time_optimization_effort":
@@ -187,6 +238,9 @@ def lower_init_module(
     """
     fakes = named_fake_tensors(module)
     names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+    if param_dtype is not None:
+        mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+        init_fn = _cast_outputs(init_fn, param_dtype, mask)
     jitted = jax.jit(init_fn, out_shardings=out_shardings)
     return jitted.lower(jax.random.PRNGKey(0)), names
 
@@ -197,6 +251,7 @@ def materialize_module_jax(
     mesh: Optional[Mesh] = None,
     plan: Optional[ShardingPlan] = None,
     seed: int = 0,
+    param_dtype=None,
 ) -> Dict[str, jax.Array]:
     """Materialize every fake parameter/buffer of a deferred-init torch
     module directly into sharded device memory, returning a flat state
@@ -209,4 +264,6 @@ def materialize_module_jax(
     fakes = named_fake_tensors(module)
     if not fakes:
         return {}
-    return materialize_params_jax(fakes, mesh=mesh, plan=plan, seed=seed)
+    return materialize_params_jax(
+        fakes, mesh=mesh, plan=plan, seed=seed, param_dtype=param_dtype
+    )
