@@ -97,3 +97,62 @@ class TestMaterialize:
     def test_non_fake_leaf_rejected(self):
         with pytest.raises(ValueError, match="non-fake"):
             materialize({"x": jnp.ones(3)})
+
+
+class TestParamDtype:
+    def test_params_collection_cast_others_kept(self):
+        import jax
+        import jax.numpy as jnp
+
+        from torchdistx_tpu.abstract import deferred_init, materialize
+
+        def init():
+            return {
+                "params": {"w": jnp.ones((4, 3)), "steps": jnp.zeros((1,), jnp.int32)},
+                "batch_stats": {"mean": jnp.zeros((3,))},
+            }
+
+        fakes = deferred_init(init)
+        out = materialize(fakes, param_dtype=jnp.bfloat16)
+        assert out["params"]["w"].dtype == jnp.bfloat16
+        assert out["params"]["steps"].dtype == jnp.int32   # non-float kept
+        assert out["batch_stats"]["mean"].dtype == jnp.float32  # other collection kept
+        # values equal the f32 materialization cast after the fact
+        full = materialize(deferred_init(init))
+        assert jax.numpy.array_equal(
+            full["params"]["w"].astype(jnp.bfloat16), out["params"]["w"]
+        )
+
+    def test_no_params_collection_casts_all_floats(self):
+        import jax.numpy as jnp
+
+        from torchdistx_tpu.abstract import deferred_init, materialize
+
+        def init():
+            return {"a": jnp.ones((2, 2)), "n": jnp.zeros((1,), jnp.int32)}
+
+        out = materialize(deferred_init(init), param_dtype=jnp.bfloat16)
+        assert out["a"].dtype == jnp.bfloat16
+        assert out["n"].dtype == jnp.int32
+
+    def test_subtree_materialization_agrees_with_full(self):
+        # The params-collection policy is judged against the FULL
+        # recording: materializing batch_stats alone must still keep it
+        # f32 (review finding — subtree used to flip to cast-everything).
+        import jax.numpy as jnp
+
+        from torchdistx_tpu.abstract import deferred_init, materialize, materialize_leaf
+
+        def init():
+            return {
+                "params": {"w": jnp.ones((4, 3))},
+                "batch_stats": {"mean": jnp.zeros((3,))},
+            }
+
+        fakes = deferred_init(init)
+        stats = materialize(fakes["batch_stats"], param_dtype=jnp.bfloat16)
+        assert stats["mean"].dtype == jnp.float32
+        w = materialize_leaf(fakes["params"]["w"], param_dtype=jnp.bfloat16)
+        assert w.dtype == jnp.bfloat16
+        m = materialize_leaf(fakes["batch_stats"]["mean"], param_dtype=jnp.bfloat16)
+        assert m.dtype == jnp.float32

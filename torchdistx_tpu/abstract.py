@@ -44,14 +44,21 @@ __all__ = [
 class _Thunk:
     """The captured init closure: the JAX-native replay recording."""
 
-    __slots__ = ("fn", "args", "kwargs", "out_treedef", "n_leaves")
+    __slots__ = ("fn", "args", "kwargs", "out_treedef", "n_leaves", "paths")
 
-    def __init__(self, fn, args, kwargs, out_treedef, n_leaves):
+    def __init__(self, fn, args, kwargs, out_treedef, n_leaves, paths=()):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.out_treedef = out_treedef
         self.n_leaves = n_leaves
+        # Leaf paths of the FULL recording: param_dtype's params-collection
+        # policy must be judged against the whole tree, not whatever
+        # subtree a materialize() call happens to pass.
+        self.paths = tuple(paths)
+
+    def has_params_collection(self) -> bool:
+        return any(p.split(".", 1)[0] == "params" for p in self.paths)
 
     def leaves_fn(self) -> Callable[[], Tuple[jax.Array, ...]]:
         def run():
@@ -126,14 +133,15 @@ def deferred_init(init_fn: Callable, *args: Any, **kwargs: Any):
     """
     out = jax.eval_shape(init_fn, *args, **kwargs)
     leaves, treedef = jax.tree.flatten(out)
-    thunk = _Thunk(init_fn, args, kwargs, treedef, len(leaves))
-
     paths_leaves = jax.tree_util.tree_flatten_with_path(out)[0]
+    names = [
+        ".".join(str(_key_str(k)) for k in path) for path, _ in paths_leaves
+    ]
+    thunk = _Thunk(init_fn, args, kwargs, treedef, len(leaves), names)
 
-    fake_leaves = []
-    for i, ((path, leaf), _) in enumerate(zip(paths_leaves, leaves)):
-        name = ".".join(str(_key_str(k)) for k in path)
-        fake_leaves.append(DeferredArray(leaf, thunk, i, name))
+    fake_leaves = [
+        DeferredArray(leaf, thunk, i, names[i]) for i, leaf in enumerate(leaves)
+    ]
     return jax.tree.unflatten(treedef, fake_leaves)
 
 
@@ -145,6 +153,17 @@ def _key_str(k) -> str:
     if hasattr(k, "name"):
         return str(k.name)
     return str(k)
+
+
+def _cast_eligible(f: DeferredArray, thunk: _Thunk) -> bool:
+    """Whether ``param_dtype`` applies to this leaf: floating, and in the
+    ``params`` collection when the FULL recording has one (judged via the
+    thunk so subtree and whole-tree materialization agree)."""
+    if not jnp.issubdtype(f.dtype, jnp.floating):
+        return False
+    if thunk.has_params_collection():
+        return f.path.split(".", 1)[0] == "params"
+    return True
 
 
 def _common_thunk(fakes: Sequence[DeferredArray]) -> _Thunk:
@@ -164,6 +183,7 @@ def materialize(
     mesh: Optional[Mesh] = None,
     plan: Optional[ShardingPlan] = None,
     specs: Optional[Any] = None,
+    param_dtype=None,
 ):
     """Materialize a pytree of :class:`DeferredArray` into real (sharded)
     ``jax.Array``s.
@@ -172,6 +192,14 @@ def materialize(
     be a matching pytree of PartitionSpec.  One XLA program computes all
     requested leaves; with a mesh, every leaf lands pre-sharded (no host
     copy, no post-hoc reshard).
+
+    ``param_dtype`` (e.g. ``jnp.bfloat16``) casts floating leaves inside
+    the compiled program, mirroring the torch frontend's policy (init math
+    at recorded precision, storage in ``param_dtype``).  When the FULL
+    recording has a flax-style top-level ``params`` collection, only that
+    collection is cast — other collections (``batch_stats`` etc.) keep
+    full precision even when materialized as a subtree on their own;
+    otherwise every floating leaf is cast.
     """
     fakes, treedef = jax.tree.flatten(tree, is_leaf=is_fake)
     for f in fakes:
@@ -181,9 +209,17 @@ def materialize(
     wanted = [f._leaf_idx for f in fakes]
     run_all = thunk.leaves_fn()
 
+    if param_dtype is not None:
+        cast = [_cast_eligible(f, thunk) for f in fakes]
+    else:
+        cast = [False] * len(fakes)
+
     def run_selected():
         leaves = run_all()
-        return tuple(leaves[i] for i in wanted)
+        return tuple(
+            leaves[i].astype(param_dtype) if c else leaves[i]
+            for i, c in zip(wanted, cast)
+        )
 
     if mesh is not None:
         if specs is not None:
@@ -212,16 +248,22 @@ def materialize_leaf(
     *,
     mesh: Optional[Mesh] = None,
     spec: Optional[PartitionSpec] = None,
+    param_dtype=None,
 ) -> jax.Array:
     """Materialize a single leaf; XLA dead-code-eliminates everything the
-    leaf does not depend on (the JAX-native ``materialize_tensor``)."""
+    leaf does not depend on (the JAX-native ``materialize_tensor``).
+
+    ``param_dtype`` follows the same policy as :func:`materialize`, so a
+    leaf materialized alone has the same dtype it would in the batch."""
     if not is_fake(fake):
         raise ValueError("`fake` is not a DeferredArray.")
     run_all = fake._thunk.leaves_fn()
     idx = fake._leaf_idx
+    do_cast = param_dtype is not None and _cast_eligible(fake, fake._thunk)
 
     def run_one():
-        return run_all()[idx]
+        leaf = run_all()[idx]
+        return leaf.astype(param_dtype) if do_cast else leaf
 
     if mesh is not None:
         fn = jax.jit(run_one, out_shardings=NamedSharding(mesh, spec or PartitionSpec()))
