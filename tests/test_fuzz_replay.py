@@ -318,8 +318,70 @@ def test_jax_bridge_replay_matches_eager(seed):
     _jax_bridge_oracle(seed, allow_data_ops=False)
 
 
+def _f64_tainted(steps):
+    """Pool indices whose VALUES depend on a float64 computation —
+    tracked through derivation and storage aliasing, so the f32
+    tolerance (below) applies only where the f64→f32 degradation can
+    actually reach, and every other output keeps bitwise coverage."""
+    taint: list = []   # per pool index: value is f64-derived
+    group: list = []   # alias-group id per pool index
+
+    def new(g=None, t=False):
+        group.append(g if g is not None else len(group))
+        taint.append(t)
+
+    def taint_group(g):
+        for i, gi in enumerate(group):
+            if gi == g:
+                taint[i] = True
+
+    for step in steps:
+        kind = step[0]
+        if kind in ("full", "arange"):
+            new()
+        elif kind == "value_read":
+            new(t=taint[step[1]])
+        elif kind == "view":
+            _, i, op, arg = step
+            n_out = arg if op == "chunk" else 1
+            for _ in range(n_out):
+                new(group[i], taint[i])
+        elif kind == "data_read":
+            new(group[step[1]], taint[step[1]])
+        elif kind in ("inplace_scalar", "uniform_", "normal_"):
+            i = step[1]
+            new(group[i], taint[i])
+        elif kind == "inplace_binary":
+            _, i, j, op = step
+            if taint[j] and not taint[i]:
+                taint_group(group[i])
+            new(group[i], taint[i])
+        elif kind in ("outofplace", "clone", "deepcopy"):
+            new(t=taint[step[1]])
+        elif kind == "cat":
+            _, i, j = step
+            new(t=taint[i] or taint[j])
+        elif kind == "cast":
+            _, i, dt = step
+            new(t=taint[i] or "float64" in str(dt))
+        elif kind == "set_data":
+            _, i, j = step
+            # pool[i] rebinds to pool[j]'s storage (no data is written:
+            # i simply aliases j from here on)
+            group[i], taint[i] = group[j], taint[j]
+            new(group[j], taint[j])
+        else:  # pragma: no cover - keep in sync with _gen_program
+            raise AssertionError(f"untracked step kind {kind!r}")
+    return {i for i, t in enumerate(taint) if t}
+
+
 def _jax_bridge_oracle(seed, *, allow_data_ops):
-    """Shared oracle: deterministic program → jax-bridge values == eager."""
+    """Shared oracle: deterministic program → jax-bridge values == eager.
+
+    Bitwise — except for outputs derived from float64 computation:
+    without jax_enable_x64, f64 computes as f32 in XLA (documented in
+    jax_bridge._dtypes), so exactly those outputs compare at f32 with
+    1-ulp tolerance instead."""
     from torchdistx_tpu.jax_bridge import materialize_params_jax
 
     steps = _gen_program(
@@ -332,10 +394,15 @@ def _jax_bridge_oracle(seed, *, allow_data_ops):
         arrays = materialize_params_jax(wanted, seed=0)
     except NotImplementedError as e:
         pytest.skip(f"op not in jax table yet: {e}")
+    tainted = _f64_tainted(steps)
     for k, arr in arrays.items():
-        assert np.array_equal(
-            eager[int(k)].numpy(), np.asarray(arr)
-        ), f"seed={seed} pool[{k}] {steps}"
+        e, j = eager[int(k)].numpy(), np.asarray(arr)
+        if int(k) in tainted:
+            assert np.allclose(
+                e.astype(np.float32), j.astype(np.float32), rtol=2e-7, atol=0
+            ), f"seed={seed} pool[{k}] {steps}"
+        else:
+            assert np.array_equal(e, j), f"seed={seed} pool[{k}] {steps}"
 
 
 @pytest.mark.parametrize("seed", range(5 * N_PROGRAMS, 5 * N_PROGRAMS + 16))
@@ -344,6 +411,18 @@ def test_jax_bridge_data_ops_match_eager(seed):
     # oracle: value reads early-materialize whole VIEW CHAINS, and later
     # recorded in-place ops must write through the cached constants'
     # alias structure (shared per-storage root boxes in _const_box).
+    _jax_bridge_oracle(seed, allow_data_ops=True)
+
+
+@pytest.mark.parametrize(
+    "seed", [202931, 204251, 205955, 206495, 209755, 212183]
+)
+def test_soak_regression_jax_bridge_exact_division(seed):
+    # Round-2 soak regression: XLA's algebraic simplifier turns division
+    # by a compile-time constant into multiply-by-reciprocal, 1 ulp off
+    # IEEE division and therefore off torch replay.  _div now hides the
+    # divisor behind lax.optimization_barrier.  (Programs casting through
+    # f64 additionally exercise the documented f32-tolerance path.)
     _jax_bridge_oracle(seed, allow_data_ops=True)
 
 

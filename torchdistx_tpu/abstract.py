@@ -44,7 +44,10 @@ __all__ = [
 class _Thunk:
     """The captured init closure: the JAX-native replay recording."""
 
-    __slots__ = ("fn", "args", "kwargs", "out_treedef", "n_leaves", "paths")
+    __slots__ = (
+        "fn", "args", "kwargs", "out_treedef", "n_leaves", "paths",
+        "_has_params",
+    )
 
     def __init__(self, fn, args, kwargs, out_treedef, n_leaves, paths=()):
         self.fn = fn
@@ -56,9 +59,12 @@ class _Thunk:
         # policy must be judged against the whole tree, not whatever
         # subtree a materialize() call happens to pass.
         self.paths = tuple(paths)
+        self._has_params = any(
+            p.split(".", 1)[0] == "params" for p in self.paths
+        )
 
     def has_params_collection(self) -> bool:
-        return any(p.split(".", 1)[0] == "params" for p in self.paths)
+        return self._has_params
 
     def leaves_fn(self) -> Callable[[], Tuple[jax.Array, ...]]:
         def run():
