@@ -557,3 +557,78 @@ class TestParamDtypePolicy:
         w = params["transformer.wte.weight"]
         assert str(w.dtype) == "bfloat16"
         assert not w.sharding.is_fully_replicated
+
+
+class TestTorchNnInitSurface:
+    """Every public torch.nn.init initializer records and lowers: the
+    reference's whole value prop is that arbitrary module __init__ code
+    replays (docs/src/deferred_init.rst); the bridge must keep up."""
+
+    CASES = {
+        "uniform": lambda w: torch.nn.init.uniform_(w, -1, 1),
+        "normal": lambda w: torch.nn.init.normal_(w),
+        "trunc_normal": lambda w: torch.nn.init.trunc_normal_(w),
+        "constant": lambda w: torch.nn.init.constant_(w, 0.25),
+        "ones": lambda w: torch.nn.init.ones_(w),
+        "zeros": lambda w: torch.nn.init.zeros_(w),
+        "xavier_uniform": lambda w: torch.nn.init.xavier_uniform_(w),
+        "xavier_normal": lambda w: torch.nn.init.xavier_normal_(w),
+        "kaiming_uniform": lambda w: torch.nn.init.kaiming_uniform_(w),
+        "kaiming_normal": lambda w: torch.nn.init.kaiming_normal_(w),
+        "orthogonal": lambda w: torch.nn.init.orthogonal_(w),
+        "sparse": lambda w: torch.nn.init.sparse_(w, sparsity=0.5),
+        "eye": lambda w: torch.nn.init.eye_(w),
+    }
+
+    @pytest.mark.parametrize("name", sorted(CASES))
+    def test_records_and_lowers(self, name):
+        import numpy as np
+
+        from torchdistx_tpu import _graph
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.fake import is_fake
+        from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+        fn = self.CASES[name]
+
+        def build():
+            w = torch.empty(8, 8)
+            fn(w)
+            return (w,)
+
+        # torch replay: bitwise parity with eager under a fixed seed
+        torch.manual_seed(3)
+        eager = build()[0]
+        torch.manual_seed(3)
+        fakes = deferred_init(build)
+        assert is_fake(fakes[0])
+        real = _graph.materialize(fakes[0], retain_context=True)
+        assert torch.equal(eager, real), name
+
+        # jax bridge: lowers and produces structurally valid values
+        w = np.asarray(materialize_params_jax({"w": fakes[0]}, seed=0)["w"])
+        assert w.shape == (8, 8) and np.isfinite(w).all()
+        if name == "eye":
+            assert np.array_equal(w, np.eye(8, dtype=np.float32))
+        elif name == "orthogonal":
+            assert np.abs(w @ w.T - np.eye(8)).max() < 1e-5
+        elif name == "sparse":
+            assert ((w == 0).sum(axis=0) >= 4).all()
+        elif name in ("constant", "ones", "zeros"):
+            assert np.array_equal(w, eager.numpy())
+
+    def test_dirac(self):
+        import numpy as np
+
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+        def build():
+            w = torch.empty(4, 4, 3)
+            torch.nn.init.dirac_(w)
+            return (w,)
+
+        eager = build()[0]
+        fakes = deferred_init(build)
+        w = np.asarray(materialize_params_jax({"w": fakes[0]}, seed=0)["w"])
+        assert np.array_equal(w, eager.numpy())

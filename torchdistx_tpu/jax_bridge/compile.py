@@ -232,6 +232,21 @@ def _first_dep_box(args, env, deps):
     raise NotImplementedError("in-place/view op with no tensor input")
 
 
+def _split_out_arg(args, env, deps):
+    """For out-variant ops (``aten.eye.m_out``): the written tensor is the
+    LAST tensor argument.  Returns (out_box, args_without_out)."""
+    from .._graph import _Dep
+
+    last = None
+    for i, a in enumerate(args):
+        if isinstance(a, _Dep):
+            last = i
+    if last is None:
+        raise NotImplementedError("out-variant op with no tensor argument")
+    node, idx = deps[args[last].index]
+    return _dep_box(node, idx, env), args[:last] + args[last + 1:]
+
+
 def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
     """Evaluate one node into ``env``, keyed by ``(id(node), tensor_idx)``."""
     if node.materialized and node.outputs is not None:
@@ -285,10 +300,29 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         outs = out if isinstance(out, (list, tuple)) else (out,)
         for i, o in enumerate(outs):
             env[(id(node), i)] = Box(o)
-    elif kind == "inplace":
-        box = _first_dep_box(args, env, node.dependencies)
-        rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
-        kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
+    elif kind in ("inplace", "out"):
+        if kind == "inplace":
+            box = _first_dep_box(args, env, node.dependencies)
+            rest_args = args[1:]
+        else:
+            # out-variant: compute from the non-out args, write into the
+            # out tensor's box (the op's output aliases it).  `out` is
+            # usually a kwarg (torch.eye(n, out=t)); positional fallback.
+            from .._graph import _Dep
+
+            out_kw = node.op.kwargs.get("out")
+            if isinstance(out_kw, _Dep):
+                dep, di = node.dependencies[out_kw.index]
+                box = _dep_box(dep, di, env)
+                rest_args = args
+            else:
+                box, rest_args = _split_out_arg(args, env, node.dependencies)
+        rest = [_resolve_value(a, env, node.dependencies) for a in rest_args]
+        kw = {
+            k: _resolve_value(v, env, node.dependencies)
+            for k, v in kwargs.items()
+            if k != "out"
+        }
         new = impl(ctx, box.read(), *rest, **kw)
         box.write(new)
         env[(id(node), 0)] = box

@@ -13,7 +13,11 @@ Each entry declares its kind:
   alias-aware via the interpreter's Box/View machinery);
 * ``view``    — ``fn(ctx, base_shape, *args, **kw) -> (fwd, bwd)`` where
   ``fwd(base)`` reads the view and ``bwd(base, value)`` scatters a new
-  view value back into the base.
+  view value back into the base;
+* ``multiview`` — like ``view`` but returns one ``(fwd, bwd)`` lens per
+  output (``aten.split``/``chunk``);
+* ``out``     — out-variant op (``aten.eye.m_out``): ``fn(ctx, current,
+  *non_out_args, **kw) -> array``, written into the ``out`` tensor's box.
 
 RNG policy: every random op draws from ``ctx.key_for(node)`` — a key
 folded from the caller's base seed and the node's chronological ``op_nr``,
@@ -75,6 +79,23 @@ def _empty(ctx, size, **kw):
 @_reg("aten.empty_like.default", "pure")
 def _empty_like(ctx, x, **kw):
     return jnp.zeros(x.shape, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg(["aten.new_empty.default", "aten.new_zeros.default"], "pure")
+def _new_empty(ctx, x, size, **kw):
+    # new_empty/new_zeros: SELF's dtype unless overridden (torch semantics);
+    # uninitialized reads would be UB, so zeros (see _empty).
+    return jnp.zeros(tuple(size), dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg("aten.new_full.default", "pure")
+def _new_full(ctx, x, size, fill_value, **kw):
+    return jnp.full(tuple(size), fill_value, dtype=_dtype_of(kw, x.dtype))
+
+
+@_reg("aten.new_ones.default", "pure")
+def _new_ones(ctx, x, size, **kw):
+    return jnp.ones(tuple(size), dtype=_dtype_of(kw, x.dtype))
 
 
 @_reg("aten.zeros_like.default", "pure")
@@ -445,6 +466,55 @@ def _to_copy(ctx, x, **kw):
     dt = kw.get("dtype")
     x = jnp.asarray(x)
     return x.astype(jax_dtype(dt)) if dt is not None else x
+
+
+@_reg("aten.index_put_.default", "inplace")
+def _index_put_(ctx, cur, indices, values, accumulate=False, **kw):
+    # torch advanced indexing: a tuple of index tensors (None = full
+    # slice).  nn.init.sparse_'s per-column zeroing is the recorded use.
+    idx = tuple(slice(None) if i is None else i for i in indices)
+    vals = jnp.asarray(values).astype(cur.dtype)
+    return cur.at[idx].add(vals) if accumulate else cur.at[idx].set(vals)
+
+
+@_reg(["aten.eye.m_out", "aten.eye.out"], "out")
+def _eye_out(ctx, cur, n, m=None, **kw):
+    # nn.init.eye_ records torch.eye(*shape, out=tensor).
+    return jnp.eye(int(n), int(m) if m is not None else None, dtype=cur.dtype)
+
+
+@_reg("aten.diagonal_copy.default", "pure")
+def _diagonal_copy(ctx, x, offset=0, dim1=0, dim2=1, **kw):
+    return jnp.diagonal(x, offset=offset, axis1=dim1, axis2=dim2)
+
+
+@_reg("aten.diagonal.default", "view")
+def _diagonal_view(ctx, base_shape, offset=0, dim1=0, dim2=1, **kw):
+    # A true view: writes through the diagonal (LSTM chrono-init style
+    # w.diagonal().fill_(1)) scatter back into the base.  torch (and
+    # numpy) put the diagonal dimension LAST on the view.
+    nd = len(base_shape)
+    d1, d2 = dim1 % nd, dim2 % nd
+    n1, n2 = base_shape[d1], base_shape[d2]
+    if offset >= 0:
+        dlen = max(0, min(n1, n2 - offset))
+        i1 = jnp.arange(dlen)
+        i2 = i1 + offset
+    else:
+        dlen = max(0, min(n1 + offset, n2))
+        i2 = jnp.arange(dlen)
+        i1 = i2 - offset
+
+    def fwd(b):
+        return jnp.diagonal(b, offset=offset, axis1=d1, axis2=d2)
+
+    def bwd(b, v):
+        bm = jnp.moveaxis(b, (d1, d2), (0, 1))   # (n1, n2, *rest)
+        vm = jnp.moveaxis(v, -1, 0)              # (dlen, *rest)
+        bm = bm.at[i1, i2].set(vm)
+        return jnp.moveaxis(bm, (0, 1), (d1, d2))
+
+    return fwd, bwd
 
 
 @_reg(["aten.linalg_qr.default", "aten.qr.default"], "pure")
