@@ -31,24 +31,45 @@ __all__ = [
 
 # Init programs execute once for milliseconds; optimized codegen buys
 # nothing while costing ~2x compile wall time on TPU.  Ask XLA for its
-# lowest effort.  Whether the active backend accepts the option is probed
-# ONCE on a trivial program, so real compile failures on init programs
-# propagate immediately instead of being retried at full effort.
-_INIT_COMPILER_OPTIONS = {"exec_time_optimization_effort": -1.0}
-_options_supported: Optional[bool] = None
+# lowest effort.  Excess precision is disabled because torch replay is
+# the parity oracle: XLA otherwise computes bf16 chains in f32 WITHOUT
+# intermediate rounding, so a recorded bf16 add followed by a cast reads
+# the unrounded value torch never produces.  Whether the active backend
+# accepts the options is probed ONCE on a trivial program, so real
+# compile failures on init programs propagate immediately instead of
+# being retried at full effort.
+_INIT_COMPILER_OPTIONS = {
+    "exec_time_optimization_effort": -1.0,
+    "xla_allow_excess_precision": False,
+}
+_options_supported: Optional[dict] = None
 
 
 def _compiler_options() -> Optional[dict]:
+    """The subset of _INIT_COMPILER_OPTIONS the active backend accepts,
+    probed per option (a backend rejecting the perf knob must not also
+    silently drop the parity-critical precision knob)."""
     global _options_supported
     if _options_supported is None:
-        try:
-            jax.jit(lambda: jax.numpy.zeros(())).lower().compile(
-                compiler_options=_INIT_COMPILER_OPTIONS
-            )
-            _options_supported = True
-        except Exception:
-            _options_supported = False
-    return _INIT_COMPILER_OPTIONS if _options_supported else None
+        accepted = {}
+        for key, value in _INIT_COMPILER_OPTIONS.items():
+            try:
+                jax.jit(lambda: jax.numpy.zeros(())).lower().compile(
+                    compiler_options={key: value}
+                )
+                accepted[key] = value
+            except Exception:
+                if key == "xla_allow_excess_precision":
+                    import warnings
+
+                    warnings.warn(
+                        "backend rejects xla_allow_excess_precision=False; "
+                        "recorded bf16 chains may read excess-precision f32 "
+                        "intermediates, losing bitwise parity with torch "
+                        "replay."
+                    )
+        _options_supported = accepted
+    return _options_supported or None
 
 
 _cache_enabled = False
@@ -231,10 +252,11 @@ def lower_init_module(
 
     The PRNG key is a *runtime argument* of the program, not baked in:
     pass it when executing, e.g.
-    ``lowered.compile(compiler_options={"exec_time_optimization_effort":
-    -1.0})(jax.random.PRNGKey(seed))`` (the low-effort option is what
-    :func:`materialize_module_jax` uses — init programs execute once, so
-    optimized codegen only costs compile wall time).
+    ``lowered.compile(compiler_options=dict(_INIT_COMPILER_OPTIONS))
+    (jax.random.PRNGKey(seed))`` — the same options
+    :func:`materialize_module_jax` uses (low-effort codegen, since init
+    programs execute once, and ``xla_allow_excess_precision=False``,
+    without which bf16 chains lose bitwise parity with torch replay).
     """
     fakes = named_fake_tensors(module)
     names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)

@@ -299,10 +299,31 @@ def _copy_(ctx, cur, src, non_blocking=False, **kw):
     return jnp.broadcast_to(jnp.asarray(src), cur.shape).astype(cur.dtype)
 
 
+def _opaque(x):
+    """Hide an arithmetic operand from XLA's algebraic simplifier, which
+    rewrites constant float arithmetic in value-changing ways —
+    ``x / c`` → ``x * (1/c)``, ``(x + c1) + c2`` → ``x + (c1 + c2)`` —
+    each 1-2 ulp off the IEEE ops torch replay executes (soak seeds
+    202931, 224215).  Applied unconditionally: under tracing every value
+    is a Tracer, so constant-ness cannot be tested, and a barrier on a
+    genuine runtime value is an identity.  Init programs run once;
+    exactness beats the folds."""
+    return jax.lax.optimization_barrier(jnp.asarray(x))
+
+
+def _scaled_operand(b, alpha):
+    """torch applies ``alpha`` to a SCALAR operand in C++ Scalar (double)
+    math before the kernel; mirror that, then make the result opaque."""
+    if isinstance(b, (int, float, bool)) and isinstance(alpha, (int, float)):
+        return _opaque(alpha * b), 1
+    return _opaque(jnp.asarray(b)), alpha
+
+
 def _binop_inplace(fn):
     def impl(ctx, cur, other, *rest, **kw):
         alpha = kw.get("alpha", rest[0] if rest else 1)
-        return fn(cur, jnp.asarray(other), alpha).astype(cur.dtype)
+        other, alpha = _scaled_operand(other, alpha)
+        return fn(cur, other, alpha).astype(cur.dtype)
 
     return impl
 
@@ -314,13 +335,9 @@ TABLE["aten.sub_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a - al *
 TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
 TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
 def _div(a, b, rounding_mode=None):
-    # Hide a constant divisor from XLA's algebraic simplifier, which
-    # strength-reduces x / const into x * (1/const) — 1 ulp off IEEE
-    # division, breaking bitwise parity with torch replay (soak seeds
-    # 202931, 204251, ...).  With the divisor behind a barrier, XLA
-    # emits a true divide; init programs run once, so the cost is nil.
-    b = jax.lax.optimization_barrier(b)
-    r = a / b
+    # Constant divisor: see _opaque (x / c would strength-reduce into
+    # x * (1/c), 1 ulp off torch's IEEE division).
+    r = a / _opaque(b)
     if rounding_mode == "floor":
         return jnp.floor(r)
     if rounding_mode == "trunc":
@@ -381,7 +398,8 @@ def _pure(fn):
 def _binop_pure(fn):
     def impl(ctx, a, b, *rest, **kw):
         alpha = kw.get("alpha", rest[0] if rest else 1)
-        return fn(jnp.asarray(a), jnp.asarray(b), alpha)
+        b, alpha = _scaled_operand(b, alpha)
+        return fn(jnp.asarray(a), b, alpha)
 
     return impl
 
