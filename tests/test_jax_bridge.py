@@ -632,3 +632,35 @@ class TestTorchNnInitSurface:
         fakes = deferred_init(build)
         w = np.asarray(materialize_params_jax({"w": fakes[0]}, seed=0)["w"])
         assert np.array_equal(w, eager.numpy())
+
+
+class TestParametrizationWrappers:
+    """torch.nn.utils weight_norm / spectral_norm construct extra
+    parameters with norm/clamp_min ops at init time; the recording must
+    lower (reductions are allclose vs torch, not bitwise: summation
+    order differs between backends)."""
+
+    def test_weight_norm(self):
+        import numpy as np
+
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.jax_bridge import materialize_module_jax
+
+        m = deferred_init(lambda: torch.nn.utils.weight_norm(torch.nn.Linear(8, 8)))
+        p = materialize_module_jax(m, seed=0)
+        g = np.asarray(p["weight_g"])
+        v = np.asarray(p["weight_v"])
+        # weight_g is the row-norm of weight_v at init
+        assert np.allclose(g[:, 0], np.sqrt((v * v).sum(axis=1)), rtol=1e-6)
+
+    def test_spectral_norm(self):
+        import numpy as np
+
+        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.jax_bridge import materialize_module_jax
+
+        m = deferred_init(lambda: torch.nn.utils.spectral_norm(torch.nn.Linear(8, 8)))
+        p = materialize_module_jax(m, seed=0)
+        u = np.asarray(p["weight_u"])
+        assert abs(np.linalg.norm(u) - 1.0) < 1e-5  # power-iteration vector is unit
+        assert {"weight_orig", "weight_u", "weight_v", "bias"} <= set(p)

@@ -443,6 +443,8 @@ for name, fn in {
     "aten.tril.default": lambda x, diagonal=0: jnp.tril(x, diagonal),
     "aten.triu.default": lambda x, diagonal=0: jnp.triu(x, diagonal),
     "aten.clamp.default": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    "aten.clamp_min.default": lambda x, min: jnp.maximum(x, min),
+    "aten.clamp_max.default": lambda x, max: jnp.minimum(x, max),
     "aten.sum.default": lambda x, **kw: jnp.sum(x),
     "aten.mean.default": lambda x, **kw: jnp.mean(x),
     "aten.outer.default": jnp.outer,
@@ -499,6 +501,36 @@ def _index_put_(ctx, cur, indices, values, accumulate=False, **kw):
 def _eye_out(ctx, cur, n, m=None, **kw):
     # nn.init.eye_ records torch.eye(*shape, out=tensor).
     return jnp.eye(int(n), int(m) if m is not None else None, dtype=cur.dtype)
+
+
+def _p_norm(x, p, dim=None, keepdim=False):
+    p = 2.0 if p is None else float(p)
+    if dim is not None and not isinstance(dim, (list, tuple)):
+        dim = (dim,)
+    ax = tuple(dim) if dim is not None else None
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == 0.0:  # torch: count of nonzeros
+        return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keepdim))
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+@_reg(["aten.norm.ScalarOpt_dim", "aten.norm.Scalar"], "pure")
+def _norm(ctx, x, p=2.0, dim=None, keepdim=False, **kw):
+    # weight_norm's norm_except_dim records norm.ScalarOpt_dim.
+    return _p_norm(x, p, dim, keepdim)
+
+
+@_reg("aten.linalg_vector_norm.default", "pure")
+def _vector_norm(ctx, x, ord=2.0, dim=None, keepdim=False, dtype=None, **kw):
+    # spectral_norm's power iteration normalizes with vector_norm.
+    if dtype is not None:
+        x = x.astype(jax_dtype(dtype))  # torch: upcast compute AND result
+    return _p_norm(x, ord, dim, keepdim)
 
 
 @_reg("aten.diagonal_copy.default", "pure")
