@@ -527,3 +527,53 @@ def test_serialize_roundtrip_matches_eager(seed, tmp_path):
     for k, f in loaded.items():
         real = _graph.materialize(f, retain_context=True)
         assert torch.equal(eager[int(k)], real), f"seed={seed} pool[{k}]"
+
+
+@pytest.mark.parametrize("seed", [765331])
+def test_soak_regression_noncontiguous_root_deepcopy(seed):
+    # Round-2 soak regression: deepcopy records a storage-order flat
+    # alias (as_strided), but torch's TensorIterator preserves input
+    # striding, so an out-of-place op on a transposed view yields a
+    # dense-but-PERMUTED root whose logical value order is not its
+    # storage order.  The bridge now records per-output meta geometry
+    # and scatters such roots into physical order before storage-
+    # relative gathers.
+    _jax_bridge_oracle(seed, allow_data_ops=True)
+
+
+def test_noncontiguous_root_deepcopy_direct():
+    import copy
+
+    from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+    def build():
+        a = torch.arange(12, dtype=torch.float32).reshape(2, 6)
+        b = a.transpose(0, 1).abs().add(3.0)  # dense, permuted layout
+        return (copy.deepcopy(b),)
+
+    eager = build()[0]
+    fakes = deferred_init(build)
+    arr = materialize_params_jax({"0": fakes[0]}, seed=0)["0"]
+    assert np.array_equal(eager.numpy(), np.asarray(arr))
+
+
+def test_set_data_noncontiguous_real_rhs_deepcopy():
+    # Review repro: a non-contiguous fake accepts a stride-matched
+    # non-contiguous REAL rhs via `p.data = real`; its constant box must
+    # be storage-ordered (through _const_box) or the recorded deepcopy's
+    # as_strided gathers scramble.
+    import copy
+
+    from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+    real = torch.arange(12, dtype=torch.float32).reshape(2, 6).t()
+
+    def build():
+        p = torch.empty(2, 6).t()
+        p.data = real
+        return (copy.deepcopy(p),)
+
+    eager = build()[0]
+    fakes = deferred_init(build)
+    arr = materialize_params_jax({"0": fakes[0]}, seed=0)["0"]
+    assert np.array_equal(eager.numpy(), np.asarray(arr))

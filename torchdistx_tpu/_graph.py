@@ -327,7 +327,7 @@ class OpNode:
     __slots__ = (
         "op", "op_nr", "key_nr", "storages", "dependencies", "dependents",
         "argument_versions", "outputs", "materialized", "loaded",
-        "session_token", "_ng", "_nid", "__weakref__",
+        "session_token", "out_geom", "_ng", "_nid", "__weakref__",
     )
 
     def __init__(self, op: Op, *, key_nr: Optional[int] = None):
@@ -361,6 +361,14 @@ class OpNode:
         self.argument_versions: List[Tuple[torch.Tensor, int]] = []
         self.outputs: Optional[List[Any]] = None
         self.materialized = False
+        # Physical meta geometry per tensor-output index:
+        # (size, stride, storage_offset, storage_numel).  The JAX bridge
+        # needs it for storage-relative ops (as_strided) whose root
+        # tensor's memory layout is not C-contiguous — torch's
+        # TensorIterator preserves input striding, so an out-of-place op
+        # on a transposed view yields a dense-but-permuted result whose
+        # logical value order differs from its storage order.
+        self.out_geom: Dict[int, Tuple] = {}
         if _native.available():
             self._ng = _native.NativeGraph.current()
             self._nid = self._ng.node_create()
@@ -670,6 +678,12 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
         if is_fake(t):
             skey = _storage_key(t._meta)
             node.storages.add(skey)
+            m = t._meta
+            if m.element_size():
+                node.out_geom[tensor_idx] = (
+                    tuple(m.shape), tuple(m.stride()), m.storage_offset(),
+                    m.untyped_storage().nbytes() // m.element_size(),
+                )
             existing = get_fake_context(t, CONTEXT_KEY)
             if existing is not None:
                 existing.update(node, tensor_idx)

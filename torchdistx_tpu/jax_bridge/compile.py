@@ -232,6 +232,65 @@ def _first_dep_box(args, env, deps):
     raise NotImplementedError("in-place/view op with no tensor input")
 
 
+def _c_contiguous(geom) -> bool:
+    """Whether (size, stride, offset, storage_numel) is a C-contiguous
+    layout spanning its whole storage — the case where a box's logical
+    value IS its storage order."""
+    size, stride, offset, snumel = geom
+    if offset != 0:
+        return False
+    expect = 1
+    for s, st in zip(reversed(size), reversed(stride)):
+        if s != 1 and st != expect:
+            return False
+        expect *= s
+    return expect == snumel
+
+
+def _live_root_geom(node):
+    """Physical geometry of the ROOT BOX owner reached from ``node``'s
+    first tensor dependency, mirroring the Box alias chain exactly
+    (views and in-place ops reuse their base's box; set_data aliases its
+    rhs).  None when the root is materialized (alias-linked constant
+    roots are already storage-ordered) or unknown."""
+    from .._graph import _Dep
+
+    def first_dep(n):
+        d = next((a for a in n.op.args if isinstance(a, _Dep)), None)
+        return None if d is None else n.dependencies[d.index]
+
+    cur = first_dep(node)
+    while cur is not None:
+        n, idx = cur
+        if n.materialized:
+            return None
+        name = _op_name(n)
+        if name == "tdx::set_data":
+            rhs = n.op.args[1]
+            cur = n.dependencies[rhs.index] if isinstance(rhs, _Dep) else None
+            continue
+        entry = TABLE.get(name)
+        if entry is None:
+            return None
+        kind = entry[0]
+        if kind in ("view", "multiview", "inplace"):
+            cur = first_dep(n)
+            continue
+        if kind == "out":
+            out_kw = n.op.kwargs.get("out")
+            if isinstance(out_kw, _Dep):
+                cur = n.dependencies[out_kw.index]
+                continue
+            last = None
+            for a in n.op.args:
+                if isinstance(a, _Dep):
+                    last = a
+            cur = n.dependencies[last.index] if last is not None else None
+            continue
+        return n.out_geom.get(idx)  # pure: this node owns the root box
+    return None
+
+
 def _split_out_arg(args, env, deps):
     """For out-variant ops (``aten.eye.m_out``): the written tensor is the
     LAST tensor argument.  Returns (out_box, args_without_out)."""
@@ -271,7 +330,12 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
             dep, idx = node.dependencies[rhs.index]
             env[(id(node), 0)] = _dep_box(dep, idx, env)
         else:
-            env[(id(node), 0)] = Box(jnp.asarray(to_numpy(rhs)))
+            # Constant (real-tensor) rhs: through _const_box so a
+            # non-contiguous rhs gets a storage-ordered root + geometry
+            # lens — a logical-order Box would scramble storage-relative
+            # as_strided gathers over it (review repro: p.data = real.t()
+            # then deepcopy).
+            env[(id(node), 0)] = _const_box(rhs, env)
         return
 
     entry = TABLE.get(name)
@@ -330,11 +394,26 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         box = _first_dep_box(args, env, node.dependencies)
         if name == "aten.as_strided.default":
             # as_strided is STORAGE-relative, not view-relative: resolve
-            # to the root box, whose value is the factory allocation that
-            # spans the storage contiguously (a view's logical value does
-            # not — gathering against it returns scrambled values).
+            # to the root box.  A factory root's logical value spans the
+            # storage contiguously; an OP-OUTPUT root can be dense but
+            # permuted (torch preserves input striding), in which case a
+            # storage-order adapter scatters the logical value into
+            # physical order first (soak seed 765331).
             while isinstance(box, ViewBox):
                 box = box.base
+            geom = _live_root_geom(node)
+            if geom is not None and not _c_contiguous(geom):
+                from .ops import strided_lens
+
+                size, stride, offset, snumel = geom
+                sfwd, sbwd = strided_lens(size, stride, offset)
+
+                def to_storage(logical, _sbwd=sbwd, _n=snumel):
+                    return _sbwd(
+                        jnp.zeros((_n,), dtype=logical.dtype), logical
+                    )
+
+                box = ViewBox(box, to_storage, lambda _l, flat, _sfwd=sfwd: _sfwd(flat))
         rest = [_resolve_value(a, env, node.dependencies) for a in args[1:]]
         kw = {k: _resolve_value(v, env, node.dependencies) for k, v in kwargs.items()}
         base_shape = tuple(box.read().shape)

@@ -269,6 +269,13 @@ def save_recording(obj: Union[torch.nn.Module, Dict[str, torch.Tensor]], path) -
                 "key_nr": n.key_nr,
                 "deps": [(index[id(dep)], out) for dep, out in n.dependencies],
                 "storages": sorted(sid(k) for k in n.storages),
+                # Physical output geometry (jax bridge: storage-relative
+                # ops over non-C-contiguous roots).  Optional — absent in
+                # older files, which fall back to assuming contiguity.
+                "geom": {
+                    i: [list(g[0]), list(g[1]), g[2], g[3]]
+                    for i, g in n.out_geom.items()
+                },
             }
         )
 
@@ -332,6 +339,10 @@ def load_recording(path) -> Dict[str, FakeTensor]:
         node = OpNode(op, key_nr=rec["key_nr"])
         node.loaded = True  # read-only graph: record_op refuses extensions
         node.storages = set(rec["storages"])
+        node.out_geom = {
+            int(i): (tuple(g[0]), tuple(g[1]), g[2], g[3])
+            for i, g in rec.get("geom", {}).items()
+        }
         node.dependencies = [(nodes[i], out) for i, out in rec["deps"]]
         for dep, _ in node.dependencies:
             dep.dependents.add(node)
