@@ -605,6 +605,20 @@ class DeferredInitContext:
 # ---------------------------------------------------------------------------
 
 
+def _c_contig_spanning(m: torch.Tensor) -> bool:
+    """C-contiguous from offset 0 AND spanning its whole storage — the
+    layout where logical value order equals storage order (the jax
+    bridge's default assumption; see OpNode.out_geom)."""
+    if m.storage_offset() != 0:
+        return False
+    expect = 1
+    for s, st in zip(reversed(m.shape), reversed(m.stride())):
+        if s != 1 and st != expect:
+            return False
+        expect *= s
+    return expect * m.element_size() == m.untyped_storage().nbytes()
+
+
 def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
     """Record one executed op whose inputs or outputs involve fake tensors."""
     dependencies: List[Tuple[OpNode, int]] = []
@@ -679,7 +693,10 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
             skey = _storage_key(t._meta)
             node.storages.add(skey)
             m = t._meta
-            if m.element_size():
+            if not _c_contig_spanning(m):
+                # Only the non-default case is worth recording: the sole
+                # consumer (the jax bridge's storage-order adapter) treats
+                # an absent entry as C-contiguous-spanning.
                 node.out_geom[tensor_idx] = (
                     tuple(m.shape), tuple(m.stride()), m.storage_offset(),
                     m.untyped_storage().nbytes() // m.element_size(),

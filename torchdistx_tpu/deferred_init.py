@@ -185,7 +185,36 @@ def deferred_init(module_fn: Callable[..., Any], *args: Any, **kwargs: Any):
     Reference: deferred_init.py:17-36.
     """
     with _deferred():
-        return module_fn(*args, **kwargs)
+        try:
+            return module_fn(*args, **kwargs)
+        except RuntimeError as e:
+            if _raised_constructing_uninitialized_param(e):
+                raise RuntimeError(
+                    "deferred_init cannot fake lazy modules (LazyLinear, "
+                    "LazyConv*, ...): their UninitializedParameter wraps a "
+                    "placeholder tensor via Tensor._make_subclass, and the "
+                    "real parameters only exist after the first forward "
+                    "pass. Construct lazy modules eagerly outside "
+                    "deferred_init (run a dummy forward to bind their "
+                    "shapes first)."
+                ) from e
+            raise
+
+
+def _raised_constructing_uninitialized_param(e: BaseException) -> bool:
+    """Whether the exception was raised inside UninitializedParameter /
+    UninitializedBuffer construction (checked via the traceback frames,
+    not error-text matching, so unrelated _make_subclass failures keep
+    their own message)."""
+    from torch.nn.parameter import UninitializedTensorMixin
+
+    tb = e.__traceback__
+    while tb is not None:
+        cls = tb.tb_frame.f_locals.get("cls")
+        if isinstance(cls, type) and issubclass(cls, UninitializedTensorMixin):
+            return True
+        tb = tb.tb_next
+    return False
 
 
 def materialize_tensor(
