@@ -247,3 +247,28 @@ class TestTlsRoundTrip:
         name = next(iter(loaded))
         w = materialize_tensor(loaded[name])
         assert torch.equal(w, torch.full((3, 3), 1.25))
+
+
+def test_noncontiguous_root_geometry_survives_roundtrip(tmp_path):
+    # The out_geom field (jax bridge storage-order adapter for dense-but-
+    # permuted roots) must survive save/load: without it a loaded
+    # recording of a deepcopied transposed op-output would materialize
+    # scrambled through the bridge.
+    import copy
+
+    import numpy as np
+
+    from torchdistx_tpu.jax_bridge import materialize_params_jax
+
+    def build():
+        a = torch.arange(12, dtype=torch.float32).reshape(2, 6)
+        b = a.transpose(0, 1).abs().add(3.0)
+        return (copy.deepcopy(b),)
+
+    eager = build()[0]
+    fakes = deferred_init(build)
+    p = tmp_path / "rec.tdx"
+    save_recording({"0": fakes[0]}, p)
+    loaded = load_recording(p)
+    arr = materialize_params_jax(loaded, seed=0)["0"]
+    assert np.array_equal(eager.numpy(), np.asarray(arr))

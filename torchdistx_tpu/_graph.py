@@ -605,18 +605,28 @@ class DeferredInitContext:
 # ---------------------------------------------------------------------------
 
 
-def _c_contig_spanning(m: torch.Tensor) -> bool:
-    """C-contiguous from offset 0 AND spanning its whole storage — the
-    layout where logical value order equals storage order (the jax
-    bridge's default assumption; see OpNode.out_geom)."""
-    if m.storage_offset() != 0:
+def geom_is_c_contig_spanning(size, stride, offset, storage_numel) -> bool:
+    """C-contiguous from offset 0 AND spanning the whole storage — the
+    layout where logical value order equals storage order.  THE single
+    predicate shared by the out_geom producer below and the jax bridge's
+    storage-order adapter (compile._live_root_geom): the producer omits
+    geometries exactly when this is true, and the consumer skips the
+    adapter under the same test, so the two must never drift."""
+    if offset != 0:
         return False
     expect = 1
-    for s, st in zip(reversed(m.shape), reversed(m.stride())):
+    for s, st in zip(reversed(tuple(size)), reversed(tuple(stride))):
         if s != 1 and st != expect:
             return False
         expect *= s
-    return expect * m.element_size() == m.untyped_storage().nbytes()
+    return expect == storage_numel
+
+
+def _c_contig_spanning(m: torch.Tensor) -> bool:
+    return geom_is_c_contig_spanning(
+        m.shape, m.stride(), m.storage_offset(),
+        m.untyped_storage().nbytes() // m.element_size(),
+    )
 
 
 def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:

@@ -203,17 +203,23 @@ def deferred_init(module_fn: Callable[..., Any], *args: Any, **kwargs: Any):
 
 def _raised_constructing_uninitialized_param(e: BaseException) -> bool:
     """Whether the exception was raised inside UninitializedParameter /
-    UninitializedBuffer construction (checked via the traceback frames,
-    not error-text matching, so unrelated _make_subclass failures keep
-    their own message)."""
+    UninitializedBuffer construction (checked via the traceback frames —
+    following ``__cause__``/``__context__`` chains, since wrapping layers
+    re-raise — not error-text matching, so unrelated _make_subclass
+    failures keep their own message)."""
     from torch.nn.parameter import UninitializedTensorMixin
 
-    tb = e.__traceback__
-    while tb is not None:
-        cls = tb.tb_frame.f_locals.get("cls")
-        if isinstance(cls, type) and issubclass(cls, UninitializedTensorMixin):
-            return True
-        tb = tb.tb_next
+    seen = set()
+    exc: Optional[BaseException] = e
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        tb = exc.__traceback__
+        while tb is not None:
+            cls = tb.tb_frame.f_locals.get("cls")
+            if isinstance(cls, type) and issubclass(cls, UninitializedTensorMixin):
+                return True
+            tb = tb.tb_next
+        exc = exc.__cause__ or exc.__context__
     return False
 
 

@@ -235,16 +235,11 @@ def _first_dep_box(args, env, deps):
 def _c_contiguous(geom) -> bool:
     """Whether (size, stride, offset, storage_numel) is a C-contiguous
     layout spanning its whole storage — the case where a box's logical
-    value IS its storage order."""
-    size, stride, offset, snumel = geom
-    if offset != 0:
-        return False
-    expect = 1
-    for s, st in zip(reversed(size), reversed(stride)):
-        if s != 1 and st != expect:
-            return False
-        expect *= s
-    return expect == snumel
+    value IS its storage order.  Shares the producer's predicate so the
+    record-time omission rule and this consumer test cannot drift."""
+    from .._graph import geom_is_c_contig_spanning
+
+    return geom_is_c_contig_spanning(*geom)
 
 
 def _live_root_geom(node):
