@@ -375,13 +375,15 @@ def _f64_tainted(steps):
     return {i for i, t in enumerate(taint) if t}
 
 
-def _jax_bridge_oracle(seed, *, allow_data_ops):
+def _jax_bridge_oracle(seed, *, allow_data_ops, single_pick=False):
     """Shared oracle: deterministic program → jax-bridge values == eager.
 
     Bitwise — except for outputs derived from float64 computation:
     without jax_enable_x64, f64 computes as f32 in XLA (documented in
     jax_bridge._dtypes), so exactly those outputs compare at f32 with
-    1-ulp tolerance instead."""
+    1-ulp tolerance instead.  With ``single_pick`` only one randomly
+    chosen tensor is materialized, exercising per-tensor call-stack
+    collection under the Box/lens interpreter."""
     from torchdistx_tpu.jax_bridge import materialize_params_jax
 
     steps = _gen_program(
@@ -390,6 +392,11 @@ def _jax_bridge_oracle(seed, *, allow_data_ops):
     eager = run(steps)
     fakes = deferred_init(run, steps)
     wanted = {str(k): t for k, t in enumerate(fakes) if is_fake(t)}
+    if single_pick:
+        if not wanted:
+            pytest.skip("no fake outputs")
+        key = random.Random(seed).choice(sorted(wanted, key=int))
+        wanted = {key: wanted[key]}
     try:
         arrays = materialize_params_jax(wanted, seed=0)
     except NotImplementedError as e:
@@ -400,6 +407,7 @@ def _jax_bridge_oracle(seed, *, allow_data_ops):
     for k, arr in arrays.items():
         e, j = to_numpy(eager[int(k)]), np.asarray(arr)
         msg = f"seed={seed} pool[{k}] dtypes {e.dtype}/{j.dtype} {steps}"
+        assert e.shape == j.shape, msg  # allclose would broadcast
         if str(e.dtype) == "float64":
             # documented: f64 computes (and stores) as f32 without x64
             assert str(j.dtype) in ("float32", "float64"), msg
@@ -577,3 +585,13 @@ def test_set_data_noncontiguous_real_rhs_deepcopy():
     fakes = deferred_init(build)
     arr = materialize_params_jax({"0": fakes[0]}, seed=0)["0"]
     assert np.array_equal(eager.numpy(), np.asarray(arr))
+
+
+@pytest.mark.parametrize("seed", range(6 * N_PROGRAMS, 6 * N_PROGRAMS + 12))
+def test_jax_bridge_single_tensor_matches_eager(seed):
+    # Materializing ONE tensor through the bridge exercises per-tensor
+    # call-stack collection (deps + in-place dependents + clobbered
+    # readers) under the Box/lens interpreter — the bridge counterpart
+    # of test_single_tensor_replay_matches_eager.  Same oracle, same
+    # dtype/tolerance policy.
+    _jax_bridge_oracle(seed, allow_data_ops=True, single_pick=True)
