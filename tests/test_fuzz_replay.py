@@ -435,14 +435,16 @@ def test_jax_bridge_data_ops_match_eager(seed):
 
 
 @pytest.mark.parametrize(
-    "seed", [202931, 204251, 205955, 206495, 209755, 212183]
+    "seed", [202931, 204251, 205955, 206495, 209755, 212183, 1220203]
 )
 def test_soak_regression_jax_bridge_exact_division(seed):
-    # Round-2 soak regression: XLA's algebraic simplifier turns division
-    # by a compile-time constant into multiply-by-reciprocal, 1 ulp off
-    # IEEE division and therefore off torch replay.  _div now hides the
-    # divisor behind lax.optimization_barrier.  (Programs casting through
-    # f64 additionally exercise the documented f32-tolerance path.)
+    # Round-2 soak regressions: XLA's algebraic simplifier (1) turns
+    # division by a compile-time constant into multiply-by-reciprocal,
+    # and (2) merges runtime divide chains div(div(x,a),b) → div(x,a*b)
+    # — each 1 ulp off IEEE division and therefore off torch replay.
+    # _div hides the divisor AND its result behind optimization_barrier.
+    # (Programs casting through f64 additionally exercise the documented
+    # f32-tolerance path.)
     _jax_bridge_oracle(seed, allow_data_ops=True)
 
 

@@ -335,9 +335,11 @@ TABLE["aten.sub_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a - al *
 TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
 TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
 def _div(a, b, rounding_mode=None):
-    # Constant divisor: see _opaque (x / c would strength-reduce into
-    # x * (1/c), 1 ulp off torch's IEEE division).
-    r = a / _opaque(b)
+    # Divisor behind _opaque: x / c would strength-reduce into x * (1/c).
+    # The RESULT is opaque too: XLA merges runtime divide chains —
+    # div(div(x, a), b) → div(x, a*b) — one rounding where torch's two
+    # sequential divisions round twice (soak seed 1220203).
+    r = _opaque(a / _opaque(b))
     if rounding_mode == "floor":
         return jnp.floor(r)
     if rounding_mode == "trunc":
@@ -427,7 +429,9 @@ TABLE["aten.pow_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a**b))
 for name, fn in {
     "aten.neg.default": lambda x: -x,
     "aten.sqrt.default": jnp.sqrt,
-    "aten.rsqrt.default": lambda x: 1.0 / jnp.sqrt(x),
+    # through _div's barriers: an unprotected 1/x division would re-open
+    # the divide-chain-merge parity gap _div closes (1 ulp vs torch)
+    "aten.rsqrt.default": lambda x: _div(1.0, jnp.sqrt(x)),
     "aten.abs.default": jnp.abs,
     "aten.exp.default": jnp.exp,
     "aten.log.default": jnp.log,
@@ -450,7 +454,7 @@ for name, fn in {
     "aten.outer.default": jnp.outer,
     "aten.sin.default": jnp.sin,
     "aten.cos.default": jnp.cos,
-    "aten.reciprocal.default": lambda x: 1.0 / x,
+    "aten.reciprocal.default": lambda x: _div(1.0, x),
     "aten.floor.default": jnp.floor,
     "aten.ceil.default": jnp.ceil,
     "aten.minimum.default": jnp.minimum,
