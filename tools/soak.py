@@ -1,0 +1,176 @@
+"""Soak-fuzz driver: run the replay-correctness oracles over large seed
+ranges in parallel worker processes.
+
+The pytest suite runs a fixed, small seed window per oracle (fast, part of
+CI); this tool is the long-running companion that found most of the
+round-2 regressions (see docs/CHANGES.md): it streams fresh seeds through
+the same oracles in ``tests/test_fuzz_replay.py`` until a wall-clock
+budget expires, and reports every failing seed so it can be pinned as a
+regression test.
+
+    python tools/soak.py --seconds 3600 --start 300000
+    python tools/soak.py --modes bridge,serialize --seeds 5000
+
+Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
+exception) and the exit code is non-zero if any occurred.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODES = ("whole", "single", "bridge", "bridge_single", "serialize")
+
+
+def _init_worker() -> None:
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    # One thread per worker: the fuzz tensors are tiny, and N workers ×
+    # ncpu intra-op threads would oversubscribe the box.
+    os.environ["OMP_NUM_THREADS"] = "1"
+    import torch
+
+    torch.set_num_threads(1)
+    # The jax-bridge oracles need the CPU platform (the axon TPU plugin
+    # ignores JAX_PLATFORMS, so go through the config API before any
+    # backend initializes); soak throughput also wants no accelerator.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _run_seed(mode: str, seed: int):
+    """Run one oracle; returns None on pass/skip, (kind, message) else."""
+    import random
+
+    import pytest
+    import torch
+
+    import test_fuzz_replay as F
+
+    try:
+        if mode == "whole":
+            # Delegate to the pytest oracle so the soak can never drift
+            # from what CI pins (rng + data ops, seeded 777).
+            F.test_data_ops_and_value_reads_match_eager(seed)
+        elif mode == "single":
+            # Superset of test_single_tensor_replay_matches_eager:
+            # data ops are allowed here too.
+            steps = F._gen_program(
+                random.Random(seed), allow_rng_ops=False, allow_data_ops=True
+            )
+            eager = F.run(steps)
+            pick = random.Random(seed).randrange(len(eager))
+            fakes = F.deferred_init(F.run, steps)
+            t = fakes[pick]
+            real = (
+                F._graph.materialize(t, retain_context=True)
+                if F.is_fake(t)
+                else t
+            )
+            if not torch.equal(eager[pick], real):
+                return ("mismatch", f"pool[{pick}]")
+        elif mode == "bridge":
+            F._jax_bridge_oracle(seed, allow_data_ops=True)
+        elif mode == "bridge_single":
+            F._jax_bridge_oracle(seed, allow_data_ops=True, single_pick=True)
+        elif mode == "serialize":
+            import tempfile
+            from pathlib import Path
+
+            with tempfile.TemporaryDirectory() as d:
+                F.test_serialize_roundtrip_matches_eager(seed, Path(d))
+        else:  # pragma: no cover
+            raise ValueError(mode)
+    except pytest.skip.Exception:
+        return None
+    except AssertionError as e:
+        return ("mismatch", str(e)[:400])
+    except Exception as e:
+        return ("error", f"{type(e).__name__}: {e}"[:400] + "\n"
+                + traceback.format_exc(limit=6)[-800:])
+    return None
+
+
+def _worker(task):
+    mode, seed = task
+    r = _run_seed(mode, seed)
+    return (mode, seed, r)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=600.0,
+                    help="wall-clock budget")
+    ap.add_argument("--seeds", type=int, default=10**9,
+                    help="max seeds per mode (budget usually binds first)")
+    ap.add_argument("--start", type=int, default=1_000_000,
+                    help="first seed (use fresh ranges across soaks)")
+    ap.add_argument("--modes", default=",".join(MODES))
+    ap.add_argument("--workers", type=int,
+                    default=max(2, min(8, (os.cpu_count() or 4) - 2)))
+    ap.add_argument("--log", default=os.path.join(REPO, "tools",
+                                                  "soak_failures.jsonl"))
+    args = ap.parse_args()
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    for m in modes:
+        if m not in MODES:
+            ap.error(f"unknown mode {m!r} (choose from {MODES})")
+
+    def tasks():
+        for i in range(args.seeds):
+            for m in modes:
+                yield (m, args.start + i)
+
+    t0 = time.time()
+    done = {m: 0 for m in modes}
+    failures = 0
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(args.workers, initializer=_init_worker) as pool:
+        # chunksize must stay 1: with chunksize>1 imap_unordered returns a
+        # plain unchunking generator without .next(timeout) (py3.12).
+        it = pool.imap_unordered(_worker, tasks())
+        while True:
+            # next(timeout=...) so the budget fires even if a worker hangs
+            # (an XLA compile deadlock must not run the soak past budget).
+            remaining = args.seconds - (time.time() - t0)
+            if remaining <= 0:
+                pool.terminate()
+                break
+            try:
+                mode, seed, r = it.next(timeout=max(1.0, remaining))
+            except mp.TimeoutError:
+                pool.terminate()
+                break
+            except StopIteration:
+                break
+            done[mode] += 1
+            if r is not None:
+                failures += 1
+                rec = {"mode": mode, "seed": seed, "kind": r[0],
+                       "detail": r[1], "ts": time.time()}
+                print(f"FAIL {mode} seed={seed}: {r[1][:160]}", flush=True)
+                with open(args.log, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            n = sum(done.values())
+            if n % 500 == 0:
+                rate = n / (time.time() - t0)
+                print(f"[{time.time()-t0:7.0f}s] {n} programs "
+                      f"({rate:.1f}/s), {failures} failures", flush=True)
+    total = sum(done.values())
+    print(json.dumps({"programs": total, "failures": failures,
+                      "seconds": round(time.time() - t0, 1),
+                      "per_mode": done}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
