@@ -46,7 +46,10 @@ def default_attention(
     logits = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
     if bias is not None:
         # bias: [H or 1, S, T] broadcastable
-        logits = logits + bias.reshape(1, KV, groups, *bias.shape[-2:])
+        if bias.shape[0] == 1:
+            logits = logits + bias[None, :, None]  # broadcast over (kv, g)
+        else:
+            logits = logits + bias.reshape(1, KV, groups, *bias.shape[-2:])
     if causal:
         T = k.shape[1]
         mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)

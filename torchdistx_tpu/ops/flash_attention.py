@@ -24,16 +24,20 @@ FlashAttention scheme laid out for the TPU memory hierarchy:
 * the backward pass is the two-kernel scheme: a dq kernel (k innermost)
   and a dk/dv kernel ((g, q) innermost), both recomputing block
   probabilities from the saved per-row logsumexp instead of storing the
-  S x T matrix.
+  S x T matrix;
+* additive bias (T5-style relative positions, ``[H or 1, S, T]`` in
+  ``default_attention``'s convention: logits = q k^T * scale + bias) is a
+  fourth operand stream — its blocks ride the same (qi, kj) tiling, with
+  the head index derived from the grid's batch*head row.  d(bias) has its
+  own kernel: grid (H, nq, nk, B) with batch innermost, so each bias
+  block accumulates every batch's ``p * (dp - delta)`` in VMEM scratch
+  and is written exactly once — blockwise memory even though bias
+  touches the full [S, T] plane.
 
 Matches the model layer ``AttnFn`` signature (`models/layers.py`), so any
 family runs on it by constructor argument, including under `jax.grad`.
 On non-TPU backends the kernels run in interpreter mode, which keeps the
 CPU test suite meaningful.
-
-Additive bias (T5 relative position) falls back to the XLA path — a
-bias-aware kernel needs one more operand stream and is not the common
-case for the long-context families this targets.
 """
 
 from __future__ import annotations
@@ -77,21 +81,26 @@ def _fwd_kernel(
     q_ref,  # [1, block_q, D]
     k_ref,  # [1, block_k, D]
     v_ref,  # [1, block_k, D]
-    o_ref,  # [1, block_q, D]
-    lse_ref,  # [1, block_q, _LANES] (lse broadcast across full lanes, the
-    #           upstream TPU flash layout — a 1-wide minor dim violates
-    #           Mosaic's (8, 128) block tiling rule; ADVICE r1)
-    acc_ref,  # VMEM [block_q, D] f32
-    m_ref,  # VMEM [block_q, _LANES] f32
-    l_ref,  # VMEM [block_q, _LANES] f32
-    *,
+    *rest,  # [bias_ref [1, block_q, block_k] if has_bias,]
+    #         o_ref [1, block_q, D],
+    #         lse_ref [1, block_q, _LANES] (lse broadcast across full
+    #           lanes, the upstream TPU flash layout — a 1-wide minor dim
+    #           violates Mosaic's (8, 128) block tiling rule; ADVICE r1),
+    #         acc_ref VMEM [block_q, D] f32,
+    #         m_ref / l_ref VMEM [block_q, _LANES] f32
     causal: bool,
     sm_scale: float,
     block_q: int,
     block_k: int,
     seq_len_k: int,
     offset: int,
+    has_bias: bool = False,
 ):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        bias_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -112,6 +121,8 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
         mask = _causal_mask(
             q_start, k_start, block_q, block_k, seq_len_k, offset, causal
         )
@@ -150,20 +161,23 @@ def _fwd_kernel(
 
 def _block_p_ds(
     q, k, lse, do, v, delta, *, causal, sm_scale, q_start, k_start, seq_len_k,
-    offset, block_q, block_k,
+    offset, block_q, block_k, bias=None,
 ):
     """Recompute one block's probabilities and d(logits) from residuals.
 
-    p  = exp(q k^T * scale - lse)         [bq, bk]
-    ds = p * (do v^T - delta) * scale     (gradient of the raw logits)
+    p  = exp(q k^T * scale [+ bias] - lse)  [bq, bk]
+    ds = p * (do v^T - delta) * scale       (gradient of the raw logits)
 
     ``lse`` and ``delta`` arrive as [bq, 1] column vectors (lane 0 of the
-    lane-broadcast row carriers).
+    lane-broadcast row carriers).  ``d(bias)`` is ``ds / scale`` —
+    i.e. ``p * (dp - delta)`` — computed by its own kernel.
     """
     s = jax.lax.dot_general(
         q * sm_scale, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     mask = _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
@@ -174,16 +188,20 @@ def _block_p_ds(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_acc,  # VMEM [block_q, D] f32
-    *,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool,
     sm_scale: float,
     block_q: int,
     block_k: int,
     seq_len_k: int,
     offset: int,
+    has_bias: bool = False,
 ):
+    if has_bias:
+        bias_ref, dq_ref, dq_acc = rest  # dq_acc: VMEM [block_q, D] f32
+    else:
+        bias_ref = None
+        dq_ref, dq_acc = rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -205,6 +223,7 @@ def _bwd_dq_kernel(
             delta_ref[0, :, :1],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
+            bias=None if bias_ref is None else bias_ref[0],
         )
         dq_acc[:] += jax.lax.dot_general(
             ds,
@@ -219,10 +238,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc,  # VMEM [block_k, D] f32
-    dv_acc,  # VMEM [block_k, D] f32
-    *,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool,
     sm_scale: float,
     block_q: int,
@@ -230,10 +246,16 @@ def _bwd_dkv_kernel(
     seq_len_k: int,
     offset: int,
     groups: int,
+    has_bias: bool = False,
 ):
     """Grid (B*KV, nk, groups*nq): the innermost dimension walks every
     (group head, q block) pair of this kv head, accumulating dk/dv in
     VMEM — GQA needs no K/V broadcast or post-hoc group reduction."""
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        bias_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest  # accs: VMEM [block_k, D] f32
     kj = pl.program_id(1)
     it = pl.program_id(2)
     n_inner = pl.num_programs(2)
@@ -260,6 +282,7 @@ def _bwd_dkv_kernel(
             delta_ref[0, :, :1],
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
+            bias=None if bias_ref is None else bias_ref[0],
         )
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -272,6 +295,52 @@ def _bwd_dkv_kernel(
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dbias_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, dbias_ref,
+    acc_ref,  # VMEM [block_q, block_k] f32
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    seq_len_k: int,
+    offset: int,
+):
+    """Grid (H, nq, nk, B), batch innermost: the output block (h, qi, kj)
+    is constant across the inner loop, so each batch's ``p * (dp - delta)``
+    accumulates in VMEM and the block is written exactly once — the bias
+    gradient never materializes per-batch [S, T] planes."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    b = pl.program_id(3)
+    nb = pl.num_programs(3)
+
+    @pl.when(b == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start, k_start = qi * block_q, kj * block_k
+
+    @pl.when(_block_needed(q_start, k_start, block_q, offset, causal))
+    def _block():
+        p, ds = _block_p_ds(
+            q_ref[0].astype(jnp.float32),
+            k_ref[0].astype(jnp.float32),
+            lse_ref[0, :, :1],
+            do_ref[0].astype(jnp.float32),
+            v_ref[0].astype(jnp.float32),
+            delta_ref[0, :, :1],
+            causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
+            seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
+            bias=bias_ref[0],
+        )
+        acc_ref[:] += ds * (1.0 / sm_scale)  # d(logits) without the q scale
+
+    @pl.when(b == nb - 1)
+    def _finish():
+        dbias_ref[0] = acc_ref[:].astype(dbias_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -302,7 +371,27 @@ def _round8(n: int) -> int:
     return max(8, ((n + 7) // 8) * 8)
 
 
-def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
+def _pad_bias(bias, block_q, block_k):
+    """Zero-pad a [Hb, S, T] bias up to block multiples on both planes."""
+    pad_q = (-bias.shape[1]) % block_q
+    pad_k = (-bias.shape[2]) % block_k
+    if pad_q or pad_k:
+        bias = jnp.pad(bias, ((0, 0), (0, pad_q), (0, pad_k)))
+    return bias
+
+
+def _bias_spec(Hb, H, block_q, block_k):
+    """Bias BlockSpec for the (bh, qi, kj) grids; a head-broadcast bias
+    (Hb == 1) pins the head index to 0."""
+    if Hb == 1:
+        return pl.BlockSpec((1, block_q, block_k), lambda bh, qi, kj: (0, qi, kj))
+    return pl.BlockSpec((1, block_q, block_k), lambda bh, qi, kj: (bh % H, qi, kj))
+
+
+def _fwd_call(
+    qh, kh, vh, groups, causal, block_q, block_k, interpret,
+    bias=None, heads=None,
+):
     BH, S, D = qh.shape
     T = kh.shape[1]
     sm_scale = 1.0 / math.sqrt(D)
@@ -310,17 +399,24 @@ def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
     kp, vp = _pad_seq(kh, block_k), _pad_seq(vh, block_k)
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape[0], heads, block_q, block_k))
+        operands.append(_pad_bias(bias, block_q, block_k))
+
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
+            has_bias=bias is not None,
         ),
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
             # lse carried at full lane width (Mosaic requires the minor
@@ -338,13 +434,13 @@ def _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
     return out[:, :S], lse  # lse stays padded; backward re-pads to match
 
 
 def _bwd_call(
     qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
-    delta3=None,
+    delta3=None, bias=None, heads=None, want_dbias=False,
 ):
     BH, S, D = qh.shape
     T = kh.shape[1]
@@ -358,6 +454,8 @@ def _bwd_call(
     dp = delta3  # [BH, Sq_padded, _LANES] like lse
     lsep = lse  # [BH, Sq_padded, _LANES], padded by fwd
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+    biasp = None if bias is None else _pad_bias(bias, block_q, block_k)
+    Hb = None if bias is None else bias.shape[0]
 
     common = dict(
         causal=causal, sm_scale=sm_scale,
@@ -366,22 +464,27 @@ def _bwd_call(
     qspec = pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0))
     rowspec = pl.BlockSpec((1, block_q, _LANES), lambda bh, i, j: (bh, i, 0))
 
+    dq_specs = [
+        qspec,
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
+        qspec,
+        rowspec,
+        rowspec,
+    ]
+    dq_operands = [qp, kp, vp, dop, lsep, dp]
+    if bias is not None:
+        dq_specs.append(_bias_spec(Hb, heads, block_q, block_k))
+        dq_operands.append(biasp)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
+        functools.partial(_bwd_dq_kernel, has_bias=bias is not None, **common),
         grid=(BH, nq, nk),
-        in_specs=[
-            qspec,
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, kj: (bh // groups, kj, 0)),
-            qspec,
-            rowspec,
-            rowspec,
-        ],
+        in_specs=dq_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(qp.shape, qh.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dp)
+    )(*dq_operands)
 
     # Query-head row for (kv head bkv, group g) is bkv*groups + g; the
     # innermost grid dim packs (g, qi) as it = g*nq + qi.
@@ -393,10 +496,29 @@ def _bwd_call(
         (1, block_q, _LANES),
         lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0),
     )
+    dkv_specs = [qspec2, kspec, kspec, qspec2, rowspec2, rowspec2]
+    dkv_operands = [qp, kp, vp, dop, lsep, dp]
+    if bias is not None:
+        # Head within the batch item for (kv head bkv, group g):
+        # (bkv % KV) * groups + g, with KV = kv heads per item.
+        KV = BKV // (BH // heads)
+        if Hb == 1:
+            bspec2 = pl.BlockSpec(
+                (1, block_q, block_k), lambda bkv, kj, it: (0, it % nq, kj)
+            )
+        else:
+            bspec2 = pl.BlockSpec(
+                (1, block_q, block_k),
+                lambda bkv, kj, it: ((bkv % KV) * groups + it // nq, it % nq, kj),
+            )
+        dkv_specs.append(bspec2)
+        dkv_operands.append(biasp)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, groups=groups, **common),
+        functools.partial(
+            _bwd_dkv_kernel, groups=groups, has_bias=bias is not None, **common
+        ),
         grid=(BKV, nk, groups * nq),
-        in_specs=[qspec2, kspec, kspec, qspec2, rowspec2, rowspec2],
+        in_specs=dkv_specs,
         out_specs=(kspec, kspec),
         out_shape=(
             jax.ShapeDtypeStruct(kp.shape, kh.dtype),
@@ -407,9 +529,69 @@ def _bwd_call(
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dp)
+    )(*dkv_operands)
 
-    return dq[:, :S], dk[:, :T], dv[:, :T]
+    if not want_dbias:
+        return dq[:, :S], dk[:, :T], dv[:, :T]
+    dbias = _dbias_call(
+        qp, kp, vp, dop, lsep, dp, biasp, groups, heads, interpret, S, T, **common
+    )
+    return dq[:, :S], dk[:, :T], dv[:, :T], dbias
+
+
+def _dbias_call(
+    qp, kp, vp, dop, lsep, dp, biasp, groups, heads, interpret, S, T,
+    *, causal, sm_scale, block_q, block_k, seq_len_k, offset,
+):
+    """Bias gradient at padded [Hb, Sq_p, Tk_p].  Padded rows and columns
+    contribute exactly zero (do rows are zero-padded, key columns are
+    masked), so the slice back to [.., S, T] is exact.
+
+    A head-broadcast bias (Hb == 1) folds the head index into the
+    innermost accumulation dimension — grid (1, nq, nk, B*H) — so the
+    gradient is produced directly at [1, S, T] without ever materializing
+    a per-head [H, S, T] intermediate in HBM."""
+    BH = qp.shape[0]
+    D = qp.shape[2]
+    B = BH // heads
+    nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
+    H, KV = heads, heads // groups
+    Hb = biasp.shape[0]
+
+    if Hb == 1:
+        # Inner index ib enumerates every (batch, head) row directly.
+        grid = (1, nq, nk, BH)
+        qmap = lambda h, qi, kj, ib: (ib, qi, 0)
+        kmap = lambda h, qi, kj, ib: ((ib // H) * KV + (ib % H) // groups, kj, 0)
+        bmap = lambda h, qi, kj, ib: (0, qi, kj)
+    else:
+        # Grid (H, nq, nk, B) with batch innermost; query-head row of
+        # (h, b) is b*H + h, its kv row b*KV + h//groups.
+        grid = (H, nq, nk, B)
+        qmap = lambda h, qi, kj, b: (b * H + h, qi, 0)
+        kmap = lambda h, qi, kj, b: (b * KV + h // groups, kj, 0)
+        bmap = lambda h, qi, kj, b: (h, qi, kj)
+    dbias = pl.pallas_call(
+        functools.partial(
+            _dbias_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, seq_len_k=seq_len_k, offset=offset,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, block_k, D), kmap),
+            pl.BlockSpec((1, block_k, D), kmap),
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, block_q, _LANES), qmap),
+            pl.BlockSpec((1, block_q, _LANES), qmap),
+            pl.BlockSpec((1, block_q, block_k), bmap),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, block_k), bmap),
+        out_shape=jax.ShapeDtypeStruct((Hb, qp.shape[1], kp.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, dp, biasp)
+    return dbias[:, :S, :T]
 
 
 # ---------------------------------------------------------------------------
@@ -438,6 +620,39 @@ def _flash_core_bwd(groups, causal, block_q, block_k, interpret, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core_bias(qh, kh, vh, bias, groups, heads, causal, block_q, block_k,
+                     interpret):
+    out, _ = _fwd_call(
+        qh, kh, vh, groups, causal, block_q, block_k, interpret,
+        bias=bias, heads=heads,
+    )
+    return out
+
+
+def _flash_core_bias_fwd(qh, kh, vh, bias, groups, heads, causal, block_q,
+                         block_k, interpret):
+    out, lse = _fwd_call(
+        qh, kh, vh, groups, causal, block_q, block_k, interpret,
+        bias=bias, heads=heads,
+    )
+    return out, (qh, kh, vh, bias, out, lse)
+
+
+def _flash_core_bias_bwd(groups, heads, causal, block_q, block_k, interpret,
+                         res, do):
+    qh, kh, vh, bias, out, lse = res
+    dq, dk, dv, dbias = _bwd_call(
+        qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
+        bias=bias, heads=heads, want_dbias=True,
+    )
+    # (a head-broadcast bias already accumulated over heads in-kernel)
+    return dq, dk, dv, dbias.astype(bias.dtype)
+
+
+_flash_core_bias.defvjp(_flash_core_bias_fwd, _flash_core_bias_bwd)
+
+
 # ---------------------------------------------------------------------------
 # public API (model AttnFn layout [B, S, H, D])
 # ---------------------------------------------------------------------------
@@ -457,13 +672,11 @@ def flash_attention(
     """Flash attention with the model ``AttnFn`` signature (GQA-aware,
     differentiable via pallas backward kernels).
 
-    ``bias`` (relative-position models) falls back to the XLA path.
+    ``bias`` is additive on the scaled logits in ``default_attention``'s
+    convention — shape ``[H or 1, S, T]`` — and runs in the kernels
+    (fwd, dq/dk/dv recompute, and a dedicated dbias kernel), not via an
+    XLA fallback.
     """
-    if bias is not None:
-        from ..models.layers import default_attention
-
-        return default_attention(q, k, v, causal=causal, bias=bias)
-
     B, S, H, D = q.shape
     T, KV = k.shape[1], k.shape[2]
     if H % KV:
@@ -481,7 +694,36 @@ def flash_attention(
     qh = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     kh = k.transpose(0, 2, 1, 3).reshape(B * KV, T, D)
     vh = v.transpose(0, 2, 1, 3).reshape(B * KV, T, D)
-    out = _flash_core(qh, kh, vh, groups, causal, bq, bk, interpret)
+    if bias is not None:
+        if (
+            bias.ndim != 3
+            or bias.shape[0] not in (1, H)
+            or bias.shape[1] not in (1, S)
+            or bias.shape[2] not in (1, T)
+        ):
+            raise ValueError(
+                f"bias must be [H or 1, S or 1, T or 1] broadcastable to "
+                f"[{H}, {S}, {T}], got {tuple(bias.shape)}."
+            )
+        if not interpret and T > bk and bk % _LANES:
+            raise ValueError(
+                f"bias kernels tile the [S, T] plane, so on TPU block_k "
+                f"({bk}) must be a multiple of {_LANES} (or >= T={T}); "
+                f"Mosaic rejects narrower minor block dims."
+            )
+        if bias.shape[1:] != (S, T):
+            # Row/column-broadcast planes (e.g. ALiBi-style [H, 1, T])
+            # expand before the kernel; autodiff of the broadcast sums
+            # dbias back to the caller's shape.  This costs a full [H, S, T]
+            # plane in HBM — same as the dense XLA path such biases used
+            # previously, so acceptable, but NOT blockwise; long-context
+            # callers should pass the full [H, S, T] bias (T5 does) or
+            # fold position terms into q/k instead.
+            bias = jnp.broadcast_to(bias, (bias.shape[0], S, T))
+        out = _flash_core_bias(qh, kh, vh, bias, groups, H, causal, bq, bk,
+                               interpret)
+    else:
+        out = _flash_core(qh, kh, vh, groups, causal, bq, bk, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
