@@ -599,30 +599,12 @@ def _dbias_call(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(qh, kh, vh, groups, causal, block_q, block_k, interpret):
-    out, _ = _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret)
-    return out
-
-
-def _flash_core_fwd(qh, kh, vh, groups, causal, block_q, block_k, interpret):
-    out, lse = _fwd_call(qh, kh, vh, groups, causal, block_q, block_k, interpret)
-    return out, (qh, kh, vh, out, lse)
-
-
-def _flash_core_bwd(groups, causal, block_q, block_k, interpret, res, do):
-    qh, kh, vh, out, lse = res
-    return _bwd_call(
-        qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret
-    )
-
-
-_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_core_bias(qh, kh, vh, bias, groups, heads, causal, block_q, block_k,
-                     interpret):
+def _flash_core(qh, kh, vh, bias, groups, heads, causal, block_q, block_k,
+                interpret):
+    """One differentiable core for both call shapes: ``bias`` is either a
+    [Hb, S, T] array or ``None`` (an empty pytree — its cotangent is
+    ``None`` and the dbias pass is skipped)."""
     out, _ = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads,
@@ -630,8 +612,8 @@ def _flash_core_bias(qh, kh, vh, bias, groups, heads, causal, block_q, block_k,
     return out
 
 
-def _flash_core_bias_fwd(qh, kh, vh, bias, groups, heads, causal, block_q,
-                         block_k, interpret):
+def _flash_core_fwd(qh, kh, vh, bias, groups, heads, causal, block_q,
+                    block_k, interpret):
     out, lse = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads,
@@ -639,9 +621,15 @@ def _flash_core_bias_fwd(qh, kh, vh, bias, groups, heads, causal, block_q,
     return out, (qh, kh, vh, bias, out, lse)
 
 
-def _flash_core_bias_bwd(groups, heads, causal, block_q, block_k, interpret,
-                         res, do):
+def _flash_core_bwd(groups, heads, causal, block_q, block_k, interpret,
+                    res, do):
     qh, kh, vh, bias, out, lse = res
+    if bias is None:
+        dq, dk, dv = _bwd_call(
+            qh, kh, vh, do, out, lse, groups, causal, block_q, block_k,
+            interpret,
+        )
+        return dq, dk, dv, None
     dq, dk, dv, dbias = _bwd_call(
         qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads, want_dbias=True,
@@ -650,7 +638,7 @@ def _flash_core_bias_bwd(groups, heads, causal, block_q, block_k, interpret,
     return dq, dk, dv, dbias.astype(bias.dtype)
 
 
-_flash_core_bias.defvjp(_flash_core_bias_fwd, _flash_core_bias_bwd)
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -720,10 +708,7 @@ def flash_attention(
             # callers should pass the full [H, S, T] bias (T5 does) or
             # fold position terms into q/k instead.
             bias = jnp.broadcast_to(bias, (bias.shape[0], S, T))
-        out = _flash_core_bias(qh, kh, vh, bias, groups, H, causal, bq, bk,
-                               interpret)
-    else:
-        out = _flash_core(qh, kh, vh, groups, causal, bq, bk, interpret)
+    out = _flash_core(qh, kh, vh, bias, groups, H, causal, bq, bk, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.flash_attention import _bwd_call, _fwd_call, _pad_seq, _round8
+from ..ops.flash_attention import _LANES, _bwd_call, _fwd_call, _pad_seq, _round8
 from ._attn_wrap import wrap_seq_parallel_attn
 from .collectives import ppermute_next
 
@@ -58,33 +58,47 @@ def _merge(o, lse, o_i, lse_i):
     return o, m + jnp.log(denom)
 
 
-def _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
+def _ring_fwd_loop(
+    qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
+    bias=None, heads=None,
+):
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     BH, s, D = qh.shape
+    t = kh.shape[1]
 
-    def flash_block(k_cur, v_cur, blk_causal):
-        out, lse3 = _fwd_call(qh, k_cur, v_cur, groups, blk_causal, bq, bk, interpret)
+    def flash_block(k_cur, v_cur, blk_causal, bias_blk=None):
+        out, lse3 = _fwd_call(
+            qh, k_cur, v_cur, groups, blk_causal, bq, bk, interpret,
+            bias=bias_blk, heads=heads,
+        )
         return out.astype(jnp.float32), lse3[:, :s, 0]
 
     def step(i, carry):
         o, lse, k_cur, v_cur = carry
+        src = (idx - i) % n  # which global key block k_cur holds
+        # Bias rides row-sharded [H, s, T_total]; slice this step's
+        # key-block columns (same scheme as the dense ring).
+        blk = (
+            None if bias is None
+            else lax.dynamic_slice_in_dim(bias, src * t, t, axis=2)
+        )
         if causal:
-            src = (idx - i) % n
+            # (blk may be statically None — an empty pytree operand)
             o_i, lse_i = lax.switch(
                 jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
                 [
-                    lambda kv: flash_block(kv[0], kv[1], False),  # past: full
-                    lambda kv: flash_block(kv[0], kv[1], True),  # diagonal
+                    lambda kv: flash_block(kv[0], kv[1], False, kv[2]),  # past
+                    lambda kv: flash_block(kv[0], kv[1], True, kv[2]),  # diagonal
                     lambda kv: (  # future: contributes nothing
                         jnp.zeros((BH, s, D), jnp.float32),
                         jnp.full((BH, s), _NEG, jnp.float32),
                     ),
                 ],
-                (k_cur, v_cur),
+                (k_cur, v_cur, blk),
             )
         else:
-            o_i, lse_i = flash_block(k_cur, v_cur, False)
+            o_i, lse_i = flash_block(k_cur, v_cur, False, blk)
         o, lse = _merge(o, lse, o_i, lse_i)
         return o, lse, ppermute_next(k_cur, axis_name), ppermute_next(v_cur, axis_name)
 
@@ -94,19 +108,32 @@ def _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
     return o.astype(qh.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
-    out, _ = _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _ring_flash(qh, kh, vh, bias, groups, heads, causal, axis_name, bq, bk,
+                interpret):
+    """One differentiable ring for both call shapes: ``bias`` is either a
+    row-sharded [Hb, s, T_total] array or ``None`` (an empty pytree —
+    its cotangent is ``None`` and the dbias strips are skipped)."""
+    out, _ = _ring_fwd_loop(
+        qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
+        bias=bias, heads=heads,
+    )
     return out
 
 
-def _ring_flash_fwd(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret):
-    out, lse = _ring_fwd_loop(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
-    return out, (qh, kh, vh, out, lse)
+def _ring_flash_fwd(qh, kh, vh, bias, groups, heads, causal, axis_name,
+                    bq, bk, interpret):
+    out, lse = _ring_fwd_loop(
+        qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
+        bias=bias, heads=heads,
+    )
+    return out, (qh, kh, vh, bias, out, lse)
 
 
-def _ring_flash_bwd(groups, causal, axis_name, bq, bk, interpret, res, do):
-    qh, kh, vh, out, lse = res
+def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
+                    res, do):
+    qh, kh, vh, bias, out, lse = res
+    has_bias = bias is not None
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     BH, s, D = qh.shape
@@ -114,41 +141,62 @@ def _ring_flash_bwd(groups, causal, axis_name, bq, bk, interpret, res, do):
     # Lane-broadcast padded global lse, the row-carrier layout the
     # backward kernels consume; delta likewise, hoisted out of the ring
     # loop (both are loop-invariant).
-    from ..ops.flash_attention import _LANES, _delta_carrier
+    from ..ops.flash_attention import _delta_carrier
 
     lse_p = _pad_seq(lse, bq)  # (BH, s_padded)
+    if lse_p.shape[1] != s:
+        # Padded query rows: with bias, exp(bias - 0) need not be ~1, so
+        # pin padded lse large-positive to force p -> 0 there (their do
+        # rows are zero anyway; this guards against inf * 0 = NaN).
+        lse_p = lse_p.at[:, s:].set(jnp.float32(1e30))
     lse3 = jnp.broadcast_to(lse_p[:, :, None], (BH, lse_p.shape[1], _LANES))
     delta3 = _delta_carrier(do, out, bq, lse3.shape)
 
-    def grads_block(k_cur, v_cur, blk_causal):
-        dq, dk, dv = _bwd_call(
+    def grads_block(k_cur, v_cur, blk_causal, bias_blk):
+        r = _bwd_call(
             qh, k_cur, v_cur, do, out, lse3, groups, blk_causal, bq, bk,
-            interpret, delta3=delta3,
+            interpret, delta3=delta3, bias=bias_blk, heads=heads,
+            want_dbias=has_bias,
         )
-        return dq.astype(jnp.float32), dk.astype(jnp.float32), dv.astype(jnp.float32)
+        return (
+            r[0].astype(jnp.float32),
+            r[1].astype(jnp.float32),
+            r[2].astype(jnp.float32),
+            r[3] if has_bias else None,  # [Hb, s, t] f32
+        )
+
+    def zeros_block(kv):
+        return (
+            jnp.zeros((BH, s, D), jnp.float32),
+            jnp.zeros((BKV, t, D), jnp.float32),
+            jnp.zeros((BKV, t, D), jnp.float32),
+            jnp.zeros((bias.shape[0], s, t), jnp.float32) if has_bias else None,
+        )
 
     def step(i, carry):
-        dq, k_cur, v_cur, dk, dv = carry
+        dq, k_cur, v_cur, dk, dv, dbias = carry
+        src = (idx - i) % n
+        blk = (
+            lax.dynamic_slice_in_dim(bias, src * t, t, axis=2)
+            if has_bias else None
+        )
         if causal:
-            src = (idx - i) % n
-            dq_i, dk_i, dv_i = lax.switch(
+            dq_i, dk_i, dv_i, db_i = lax.switch(
                 jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
                 [
-                    lambda kv: grads_block(kv[0], kv[1], False),
-                    lambda kv: grads_block(kv[0], kv[1], True),
-                    lambda kv: (
-                        jnp.zeros((BH, s, D), jnp.float32),
-                        jnp.zeros((BKV, t, D), jnp.float32),
-                        jnp.zeros((BKV, t, D), jnp.float32),
-                    ),
+                    lambda kv: grads_block(kv[0], kv[1], False, kv[2]),
+                    lambda kv: grads_block(kv[0], kv[1], True, kv[2]),
+                    zeros_block,  # future: contributes nothing
                 ],
-                (k_cur, v_cur),
+                (k_cur, v_cur, blk),
             )
         else:
-            dq_i, dk_i, dv_i = grads_block(k_cur, v_cur, False)
+            dq_i, dk_i, dv_i, db_i = grads_block(k_cur, v_cur, False, blk)
         dq = dq + dq_i
-        dk = dk + dk_i
-        dv = dv + dv_i
+        if has_bias:
+            # Each global key block is visited exactly once per cycle, so
+            # its dbias column strip is written (not accumulated) in place.
+            dbias = lax.dynamic_update_slice_in_dim(dbias, db_i, src * t, axis=2)
         # dk/dv rotate WITH their k/v blocks: after the full cycle each
         # accumulator arrives back on its block's home device holding
         # every device's contribution.
@@ -156,14 +204,26 @@ def _ring_flash_bwd(groups, causal, axis_name, bq, bk, interpret, res, do):
             dq,
             ppermute_next(k_cur, axis_name),
             ppermute_next(v_cur, axis_name),
-            ppermute_next(dk, axis_name),
-            ppermute_next(dv, axis_name),
+            ppermute_next(dk + dk_i, axis_name),
+            ppermute_next(dv + dv_i, axis_name),
+            dbias,
         )
 
     dq0 = jnp.zeros((BH, s, D), jnp.float32)
     dkv0 = jnp.zeros((BKV, t, D), jnp.float32)
-    dq, _, _, dk, dv = lax.fori_loop(0, n, step, (dq0, kh, vh, dkv0, dkv0))
-    return dq.astype(qh.dtype), dk.astype(kh.dtype), dv.astype(vh.dtype)
+    dbias0 = (
+        jnp.zeros((bias.shape[0], s, bias.shape[2]), jnp.float32)
+        if has_bias else None
+    )
+    dq, _, _, dk, dv, dbias = lax.fori_loop(
+        0, n, step, (dq0, kh, vh, dkv0, dkv0, dbias0)
+    )
+    return (
+        dq.astype(qh.dtype),
+        dk.astype(kh.dtype),
+        dv.astype(vh.dtype),
+        dbias.astype(bias.dtype) if has_bias else None,
+    )
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -176,6 +236,7 @@ def ring_flash_attention(
     *,
     axis_name: str = "sp",
     causal: bool = True,
+    bias: Optional[jax.Array] = None,  # [H or 1, s, T_total] row-sharded
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -185,7 +246,12 @@ def ring_flash_attention(
     Causal masking requires equal local query/key chunks (self-attention);
     causal cross-attention should use the dense ring
     (:func:`ring_attention.ring_attention`), which handles the
-    bottom-right offset."""
+    bottom-right offset.
+
+    ``bias`` (additive, T5-style) arrives sharded over the query rows with
+    the full key extent resident, exactly like the dense ring; each step
+    slices this step's key-block columns and runs them through the
+    bias-enabled flash kernels (including dbias in the backward)."""
     B, s, H, D = q.shape
     t, KV = k.shape[1], k.shape[2]
     if H % KV:
@@ -204,7 +270,28 @@ def ring_flash_attention(
     qh = q.transpose(0, 2, 1, 3).reshape(B * H, s, D)
     kh = k.transpose(0, 2, 1, 3).reshape(B * KV, t, D)
     vh = v.transpose(0, 2, 1, 3).reshape(B * KV, t, D)
-    out = _ring_flash(qh, kh, vh, groups, causal, axis_name, bq, bk, interpret)
+    if bias is not None:
+        n = lax.psum(1, axis_name)  # static: ring size
+        if (
+            bias.ndim != 3
+            or bias.shape[0] not in (1, H)
+            or bias.shape[1] != s
+            or bias.shape[2] != n * t
+        ):
+            # (a [H, s, t] per-step shape here would silently clamp every
+            # dynamic slice to column 0 — reject it loudly instead)
+            raise ValueError(
+                f"ring bias must be row-sharded [H or 1, s, T_total] = "
+                f"[{H} or 1, {s}, {n * t}], got {tuple(bias.shape)}."
+            )
+        if not interpret and t > bk and bk % _LANES:
+            raise ValueError(
+                f"bias kernels tile the [s, t] plane, so on TPU block_k "
+                f"({bk}) must be a multiple of {_LANES} (or >= the local "
+                f"key chunk t={t}); Mosaic rejects narrower minor block dims."
+            )
+    out = _ring_flash(qh, kh, vh, bias, groups, H, causal, axis_name, bq, bk,
+                      interpret)
     return out.reshape(B, H, s, D).transpose(0, 2, 1, 3)
 
 
@@ -220,44 +307,40 @@ def make_ring_flash_attention(
     """Build an ``AttnFn`` running flash-kernel ring attention over
     ``mesh`` — the drop-in long-context choice on TPU hardware.
 
-    Additive bias and causal cross-attention fall back to the dense ring
-    (same sharding layout) transparently, so models pass a single
-    ``attn_fn`` and every call pattern works.
+    Additive bias runs through the bias-enabled flash kernels (so T5-class
+    families get the blockwise path too); only causal *cross*-attention
+    falls back to the dense ring (same sharding layout), which handles the
+    bottom-right offset.  Models pass a single ``attn_fn`` and every call
+    pattern works.
     """
-    from .ring_attention import make_ring_attention, ring_attention
+    from .ring_attention import ring_attention
 
     present = set(mesh.axis_names)
     if seq_axis not in present:
         from ..models.layers import default_attention
 
         return default_attention
-    dense = make_ring_attention(
-        mesh, seq_axis=seq_axis, batch_axes=batch_axes, head_axes=head_axes
-    )
     b = tuple(a for a in batch_axes if a in present) or None
     h = tuple(a for a in head_axes if a in present) or None
 
     def per_device(q, k, v, causal, bias):
-        # bias=None always here: attn_fn routes bias to the dense ring.
         if causal and q.shape[1] != k.shape[1]:
             # Causal cross-attention: the dense ring handles the
             # bottom-right offset the flash path does not.
-            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+            return ring_attention(
+                q, k, v, axis_name=seq_axis, causal=causal, bias=bias
+            )
         return ring_flash_attention(
-            q, k, v, axis_name=seq_axis, causal=causal,
+            q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
             block_q=block_q, block_k=block_k,
         )
 
-    flash_wrapped = wrap_seq_parallel_attn(
+    return wrap_seq_parallel_attn(
         mesh,
         name="ring flash attention",
         spec=P(b, seq_axis, h, None),
+        # [H, S_q, S_k] bias: heads over tp, query rows over sp, full key
+        # extent resident (ring steps slice the key-block columns).
+        bias_spec=P(h, seq_axis, None),
         per_device=per_device,
     )
-
-    def attn_fn(q, k, v, *, causal=True, bias=None):
-        if bias is not None:
-            return dense(q, k, v, causal=causal, bias=bias)
-        return flash_wrapped(q, k, v, causal=causal)
-
-    return attn_fn
