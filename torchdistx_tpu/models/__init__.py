@@ -1,4 +1,4 @@
-"""Model families: GPT-2, Llama, T5, Mixtral — flax.linen, TPU-first."""
+"""Model families: GPT-2, Llama, T5, Mixtral, ViT — flax.linen, TPU-first."""
 
 from .configs import (
     GPT2_125M,
@@ -11,20 +11,26 @@ from .configs import (
     TINY_GPT2,
     TINY_MOE,
     TINY_T5,
+    TINY_VIT,
+    VIT_B16,
+    VIT_L16,
     EncDecConfig,
     MoEConfig,
     TransformerConfig,
+    VisionConfig,
 )
 from .decomposition import PipelineDecomposition
 from .gpt2 import GPT2Model, make_gpt2
 from .llama import LlamaModel, make_llama
 from .mixtral import make_mixtral
-from .plans import decoder_lm_plan, t5_plan
+from .plans import decoder_lm_plan, t5_plan, vit_plan
 from .t5 import T5Model, make_t5
+from .vit import ViTModel, make_vit
 
 __all__ = [
     "TransformerConfig",
     "EncDecConfig",
+    "VisionConfig",
     "MoEConfig",
     "PRESETS",
     "GPT2_125M",
@@ -36,14 +42,20 @@ __all__ = [
     "TINY_GPT2",
     "TINY_MOE",
     "TINY_T5",
+    "TINY_VIT",
+    "VIT_B16",
+    "VIT_L16",
     "GPT2Model",
     "LlamaModel",
     "PipelineDecomposition",
     "T5Model",
+    "ViTModel",
     "make_gpt2",
     "make_llama",
     "make_mixtral",
     "make_t5",
+    "make_vit",
     "decoder_lm_plan",
     "t5_plan",
+    "vit_plan",
 ]

@@ -73,6 +73,29 @@ class EncDecConfig:
     tie_embeddings: bool = True
 
 
+@dataclass(frozen=True)
+class VisionConfig:
+    """ViT-style image encoder: a TransformerConfig stack over patches."""
+
+    encoder: TransformerConfig
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    pool: str = "cls"  # "cls" (class token) | "gap" (mean over patches)
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pool == "cls" else 0)
+
+    def replace(self, **kw) -> "VisionConfig":
+        return replace(self, **kw)
+
+
 # ---------------------------------------------------------------------------
 # Presets (sizes follow the public model cards; see BASELINE.md)
 # ---------------------------------------------------------------------------
@@ -148,6 +171,26 @@ T5_11B = EncDecConfig(
     tie_embeddings=True,
 )
 
+_VIT_STACK = TransformerConfig(
+    vocab_size=1,  # unused by the vision family
+    d_model=768,
+    n_layers=12,
+    n_heads=12,
+    d_ff=3072,
+    max_seq_len=197,
+    use_bias=True,
+    activation="gelu",
+    norm="layernorm",
+    positions="learned",
+    norm_eps=1e-6,
+)
+
+VIT_B16 = VisionConfig(encoder=_VIT_STACK)
+
+VIT_L16 = VisionConfig(
+    encoder=_VIT_STACK.replace(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+)
+
 # -- tiny variants for tests / dry runs ------------------------------------
 
 TINY = TransformerConfig(
@@ -180,14 +223,27 @@ TINY_T5 = EncDecConfig(
     vocab_size=256,
 )
 
+TINY_VIT = VisionConfig(
+    encoder=_VIT_STACK.replace(
+        d_model=64, n_layers=2, n_heads=4, d_ff=128, max_seq_len=17,
+        dtype=jnp.float32,
+    ),
+    image_size=32,
+    patch_size=8,
+    n_classes=10,
+)
+
 PRESETS = {
     "gpt2-125m": GPT2_125M,
     "llama3-8b": LLAMA3_8B,
     "llama3-70b": LLAMA3_70B,
     "mixtral-8x7b": MIXTRAL_8X7B,
     "t5-11b": T5_11B,
+    "vit-b16": VIT_B16,
+    "vit-l16": VIT_L16,
     "tiny": TINY,
     "tiny-gpt2": TINY_GPT2,
     "tiny-moe": TINY_MOE,
     "tiny-t5": TINY_T5,
+    "tiny-vit": TINY_VIT,
 }

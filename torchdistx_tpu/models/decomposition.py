@@ -63,7 +63,7 @@ class PipelineDecomposition:
     All callables take the model's ``params["params"]`` subtree (``p``).
     """
 
-    # p, tokens [B, S] -> activations [B, S, d_model]
+    # p, inputs (tokens [B, S] or images [B, H, W, C]) -> [B, S, d_model]
     embed: Callable[[Any, jax.Array], jax.Array]
     # p -> the scan-stacked per-layer param pytree (leading dim n_layers),
     # which pipeline_plan_overrides shards over ``pp``
@@ -71,5 +71,7 @@ class PipelineDecomposition:
     # sequence length -> positional side input threaded to every block
     # (rope angles), or None for families with learned/absolute positions
     angles: Callable[[int], Optional[jax.Array]]
-    # p, activations [B, S, d_model] -> logits [B, S, vocab]
+    # p, activations [B, S, d_model] -> logits ([B, S, vocab] or [B, n_cls])
     head: Callable[[Any, jax.Array], jax.Array]
+    # block attention masking (False for encoder families, e.g. ViT)
+    causal: bool = True

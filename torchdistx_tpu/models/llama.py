@@ -21,10 +21,13 @@ from .layers import AttnFn, Block, default_attention, make_norm, rope_frequencie
 
 class _BlockWithCarry(nn.Module):
     """Adapter giving Block the carry signature nn.scan expects; applies
-    rematerialization per the config."""
+    rematerialization per the config.  Carry is ``(x, angles)`` with
+    ``angles=None`` for non-rope families; encoder families (ViT) set
+    ``causal=False``."""
 
     cfg: TransformerConfig
     attn_fn: AttnFn
+    causal: bool = True
 
     @nn.compact
     def __call__(self, carry, _):
@@ -32,7 +35,9 @@ class _BlockWithCarry(nn.Module):
         block_cls = Block
         if self.cfg.remat == "full":
             block_cls = nn.remat(Block, prevent_cse=False, static_argnums=())
-        x = block_cls(self.cfg, attn_fn=self.attn_fn, name="block")(x, angles=angles)
+        x = block_cls(self.cfg, attn_fn=self.attn_fn, name="block")(
+            x, angles=angles, causal=self.causal
+        )
         return (x, angles), None
 
 

@@ -19,6 +19,23 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.sharding import ShardingPlan
 
 
+def _block_rules(fsdp: Optional[str], tp: Optional[str]):
+    """Megatron-style rules for the shared Block (attention + dense MLP)
+    param layouts — one copy consumed by every family plan."""
+    return [
+        # attention projections [L, d, H, hd] / [L, H, hd, d]
+        (r".*attn\.w[qkv]\.kernel", P(None, fsdp, tp, None)),
+        (r".*attn\.wo\.kernel", P(None, tp, None, fsdp)),
+        (r".*attn\.w[qkv]\.bias", P(None, tp, None)),
+        (r".*attn\.wo\.bias", P()),
+        # dense MLP [L, d, ff] / [L, ff, d]
+        (r".*mlp\.w_(gate|up)\.kernel", P(None, fsdp, tp)),
+        (r".*mlp\.w_down\.kernel", P(None, tp, fsdp)),
+        (r".*mlp\.w_(gate|up)\.bias", P(None, tp)),
+        (r".*mlp\.w_down\.bias", P()),
+    ]
+
+
 def decoder_lm_plan(
     *,
     fsdp: Optional[str] = "fsdp",
@@ -30,17 +47,8 @@ def decoder_lm_plan(
     Pass ``tp=None`` (etc.) to drop an axis entirely when building a plan
     for a mesh that intentionally lacks it — no absent-axis warnings."""
     return ShardingPlan(
-        [
-            # attention projections [L, d, H, hd] / [L, H, hd, d]
-            (r".*attn\.w[qkv]\.kernel", P(None, fsdp, tp, None)),
-            (r".*attn\.wo\.kernel", P(None, tp, None, fsdp)),
-            (r".*attn\.w[qkv]\.bias", P(None, tp, None)),
-            (r".*attn\.wo\.bias", P()),
-            # dense MLP [L, d, ff] / [L, ff, d]
-            (r".*mlp\.w_(gate|up)\.kernel", P(None, fsdp, tp)),
-            (r".*mlp\.w_down\.kernel", P(None, tp, fsdp)),
-            (r".*mlp\.w_(gate|up)\.bias", P(None, tp)),
-            (r".*mlp\.w_down\.bias", P()),
+        _block_rules(fsdp, tp)
+        + [
             # MoE experts [L, E, d, ff] / [L, E, ff, d]
             (r".*moe\.w_(gate|up)", P(None, ep, fsdp, tp)),
             (r".*moe\.w_down", P(None, ep, tp, fsdp)),
@@ -50,6 +58,20 @@ def decoder_lm_plan(
             (r".*wpe\.embedding", P(None, fsdp)),
             (r".*lm_head\.kernel", P(fsdp, tp)),
             # norms and everything else: replicated (default)
+        ]
+    )
+
+
+def vit_plan(*, fsdp: Optional[str] = "fsdp", tp: Optional[str] = "tp") -> ShardingPlan:
+    """2D plan for ViTModel param trees (shared Block rules + the vision
+    stem: [P, P, C, D] conv kernel over tp — the RGB channel dim is 3,
+    never divisible — positions over fsdp)."""
+    return ShardingPlan(
+        _block_rules(fsdp, tp)
+        + [
+            (r".*patch_embed\.kernel", P(None, None, None, tp)),
+            (r".*pos_embed", P(None, None, fsdp)),
+            (r".*head\.kernel", P(fsdp, tp)),
         ]
     )
 

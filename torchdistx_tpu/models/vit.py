@@ -1,0 +1,149 @@
+"""ViT family (vision transformer image encoder), flax.linen, TPU-first.
+
+The reference has no model zoo at all (SURVEY.md §2.5 — torchdistX is the
+*enabler* for init workflows); this repo's vision coverage previously
+existed only through the torch/HF bridge (CLIP parity in
+tests/test_hf_models.py).  This native family gives the JAX frontend a
+vision architecture with the same TPU structure as the text families:
+
+* patch embedding as a strided ``nn.Conv`` (maps straight onto the MXU —
+  a [P, P, C, D] conv at stride P is one big matmul per patch grid);
+* encoder blocks are the shared pre-norm :class:`~.layers.Block` with
+  ``causal=False``, stacked with ``nn.scan`` (O(1) compile in depth,
+  clean leading layer dim for the ``pp`` axis);
+* pluggable attention: any ``AttnFn`` — flash kernels, ring, Ulysses —
+  by constructor argument, like every other family;
+* class-token or mean pooling ahead of the linear head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .configs import VisionConfig
+from .layers import AttnFn, default_attention, make_norm
+from .llama import _BlockWithCarry
+
+
+def _check_patch_divisible(cfg: VisionConfig, images: jax.Array) -> None:
+    """Shared by __call__ and the pipeline decomposition so both forward
+    paths fail identically (a VALID strided conv would otherwise silently
+    crop the border)."""
+    _, H, W, _ = images.shape
+    p = cfg.patch_size
+    if H % p or W % p:
+        raise ValueError(
+            f"image dims ({H}x{W}) must be divisible by patch_size={p}."
+        )
+
+
+def _patch_conv(cfg: VisionConfig, name: str | None = None) -> nn.Conv:
+    """The patch-embedding conv, constructed identically in __call__ and
+    the decomposition (one copy of the kernel/stride/dtype choices)."""
+    enc = cfg.encoder
+    return nn.Conv(
+        enc.d_model,
+        kernel_size=(cfg.patch_size, cfg.patch_size),
+        strides=(cfg.patch_size, cfg.patch_size),
+        padding="VALID",
+        dtype=enc.dtype,
+        param_dtype=enc.param_dtype,
+        name=name,
+    )
+
+
+class ViTModel(nn.Module):
+    cfg: VisionConfig
+    attn_fn: AttnFn = default_attention
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        """images [B, H, W, C] → class logits [B, n_classes] in f32."""
+        cfg = self.cfg
+        enc = cfg.encoder
+        _check_patch_divisible(cfg, images)
+        x = _patch_conv(cfg, name="patch_embed")(images.astype(enc.dtype))
+        B, gh, gw, D = x.shape
+        x = x.reshape(B, gh * gw, D)
+
+        if cfg.pool == "cls":
+            cls = self.param(
+                "cls", nn.initializers.zeros, (1, 1, enc.d_model), enc.param_dtype
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(x.dtype), (B, 1, D)), x], axis=1
+            )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], enc.d_model),
+            enc.param_dtype,
+        )
+        x = x + pos.astype(x.dtype)
+
+        ScanBlocks = nn.scan(
+            _BlockWithCarry,
+            variable_axes={"params": 0, "losses": 0},
+            split_rngs={"params": True},
+            length=enc.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (x, _), _ = ScanBlocks(enc, self.attn_fn, causal=False, name="blocks")(
+            (x, None), None
+        )
+
+        x = make_norm(enc, name="final_norm")(x)
+        x = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        logits = nn.Dense(
+            cfg.n_classes,
+            dtype=enc.dtype,
+            param_dtype=enc.param_dtype,
+            name="head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+    def pipeline_decomposition(self) -> "PipelineDecomposition":  # noqa: F821
+        """Export for the pipeline runner: patch embedding (+cls/pos),
+        scan-stacked non-causal blocks, pooled classifier head."""
+        from .decomposition import PipelineDecomposition, apply_final_norm
+
+        cfg = self.cfg
+        enc = cfg.encoder
+
+        def embed(p, images):
+            _check_patch_divisible(cfg, images)
+            x = _patch_conv(cfg).apply(
+                {"params": p["patch_embed"]}, images.astype(enc.dtype)
+            )
+            B, gh, gw, D = x.shape
+            x = x.reshape(B, gh * gw, D)
+            if cfg.pool == "cls":
+                x = jnp.concatenate(
+                    [jnp.broadcast_to(p["cls"].astype(x.dtype), (B, 1, D)), x],
+                    axis=1,
+                )
+            return x + p["pos_embed"].astype(x.dtype)
+
+        def block_params(p):
+            return p["blocks"]["block"]
+
+        def angles(S):
+            return None  # learned absolute positions, applied at embed
+
+        def head(p, x):
+            x = apply_final_norm(enc, p, x)
+            x = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+            k = p["head"]["kernel"].astype(enc.dtype)
+            return (x @ k + p["head"]["bias"].astype(enc.dtype)).astype(
+                jnp.float32
+            )
+
+        return PipelineDecomposition(
+            embed, block_params, angles, head, causal=False
+        )
+
+
+def make_vit(cfg: VisionConfig, attn_fn: AttnFn = default_attention) -> ViTModel:
+    return ViTModel(cfg, attn_fn=attn_fn)

@@ -74,12 +74,14 @@ def pipeline_forward(
     return lax.psum(jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), axis_name)
 
 
-def _block_chain(cfg: TransformerConfig, attn_fn, angles):
+def _block_chain(cfg: TransformerConfig, attn_fn, angles, causal=True):
     block = Block(cfg, attn_fn=attn_fn)
 
     def chain(stacked_params, x):
         def body(carry, layer_params):
-            y = block.apply({"params": layer_params}, carry, angles=angles)
+            y = block.apply(
+                {"params": layer_params}, carry, angles=angles, causal=causal
+            )
             return y, None
 
         y, _ = lax.scan(body, x, stacked_params)
@@ -91,7 +93,7 @@ def _block_chain(cfg: TransformerConfig, attn_fn, angles):
 def pipelined_decoder_apply(
     cfg: TransformerConfig,
     params,
-    tokens: jax.Array,  # [B, S]
+    tokens: jax.Array,  # [B, S] tokens (or [B, H, W, C] images for ViT)
     mesh: Mesh,
     *,
     decomp=None,
@@ -127,13 +129,14 @@ def pipelined_decoder_apply(
         decomp = family(cfg, attn_fn=attn_fn).pipeline_decomposition()
 
     p = params["params"]
-    B, S = tokens.shape
+    B = tokens.shape[0]  # tokens [B, S] or images [B, H, W, C]
     assert B % n_microbatches == 0, (
         f"n_microbatches ({n_microbatches}) must divide the batch size ({B})"
     )
 
     x = decomp.embed(p, tokens)
-    chain = _block_chain(cfg, attn_fn, decomp.angles(S))
+    S = x.shape[1]  # post-embed length (patches + cls for vision families)
+    chain = _block_chain(cfg, attn_fn, decomp.angles(S), causal=decomp.causal)
 
     x_mb = x.reshape(n_microbatches, B // n_microbatches, S, cfg.d_model)
 
