@@ -14,7 +14,10 @@ Extra phases (reported as extra JSON fields, best-effort):
 * ``llama``  — largest Llama-class config that comfortably fits the
   single TPU chip: deferred_init → materialize, wall + RSS.
 * ``flash``  — pallas flash-attention forward vs stock attention on the
-  real chip, achieved TFLOP/s (compiled, not interpret mode).
+  real chip, achieved TFLOP/s (compiled, not interpret mode); the
+  ``flash_bwd`` (training-step fwd+grad) and ``flash_bias`` (T5
+  relative-position operand) flavors measure the backward and bias
+  kernels the same way.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
 where value is the framework path's wall time and vs_baseline is the
@@ -248,16 +251,36 @@ def phase_llama70b_lower() -> dict:
     }
 
 
-def phase_flash() -> dict:
-    """Flash-attention fwd vs stock attention on the default device;
-    reports achieved TFLOP/s (compiled path, interpret=False on TPU).
+def _env_ints(name: str, default: str, n: int):
+    raw = os.environ.get(name) or default
+    vals = [int(x) for x in raw.split(",")]
+    if len(vals) != n:
+        raise ValueError(f"{name}={raw!r}: expected {n} comma-separated ints")
+    return vals
+
+
+def _flash_phase(mode: str) -> dict:
+    """Shared runner for the flash kernel phases (one schema, one timing
+    methodology, three workloads):
+
+    * ``fwd``  — causal forward, the model hot loop;
+    * ``bwd``  — forward + grad wrt (q, k, v), the training-step shape;
+    * ``bias`` — non-causal forward with a [H, S, S] f32 additive bias
+      (T5 relative positions), the kernels' fourth operand stream.
 
     Timing methodology: the axon TPU tunnel dispatches asynchronously and
     ``block_until_ready`` returns before device execution completes, while
     a value fetch pays ~65 ms of HTTP round-trip.  So each measurement
-    chains N data-dependent iterations inside one jit (out feeds back as
-    q) and differences two N values — constant latency and dispatch cost
-    cancel, leaving pure device time per iteration.
+    chains N data-dependent iterations inside one jit (the attention
+    output feeds back as q; in bwd mode all three cotangents feed back so
+    no backward kernel can be hoisted) and differences two N values —
+    constant latency and dispatch cost cancel, leaving pure device time
+    per iteration.
+
+    Dynamic trip count: ONE compiled program serves both N values
+    (fori_loop with a traced bound lowers to while_loop), so each
+    attention flavor pays a single Mosaic/XLA compile — cold compiles
+    through the tunnel are the dominant cost.
     """
     jax = _init_jax(cache=True)
     import jax.numpy as jnp
@@ -266,53 +289,83 @@ def phase_flash() -> dict:
     from torchdistx_tpu.models.layers import default_attention
     from torchdistx_tpu.ops.flash_attention import flash_attention
 
-    def env_ints(name: str, default: str, n: int):
-        raw = os.environ.get(name) or default
-        vals = [int(x) for x in raw.split(",")]
-        if len(vals) != n:
-            raise ValueError(f"{name}={raw!r}: expected {n} comma-separated ints")
-        return vals
-
-    # Overridable so the phase can be driven end-to-end off-accelerator
+    # Overridable so the phases can be driven end-to-end off-accelerator
     # (pallas interpret mode is far too slow at the real shape on CPU).
-    B, H, S, D = env_ints("TDX_FLASH_SHAPE", "4,16,2048,64", 4)
+    B, H, S, D = _env_ints("TDX_FLASH_SHAPE", "4,16,2048,64", 4)
     q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
-    # Useful FLOPs under causal masking: ~half the S x S score matrix for
-    # both qk^T and av (2 matmuls x 2 FLOP/MAC x S^2/2).
-    flops = 2.0 * B * H * S * S * D
+    bias = (
+        jax.random.normal(jax.random.PRNGKey(3), (H, S, S), jnp.float32)
+        if mode == "bias" else None
+    )
 
-    n_lo, n_hi = env_ints("TDX_FLASH_ITERS", "2,34", 2)
+    # 2 FLOP/MAC x 2 matmuls, S^2/2 useful plane under causal masking
+    # (full plane for the non-causal bias flavor); backward adds 5
+    # matmuls (dq, dk, dv + 2 recomputes) for 7 total.
+    flops = {
+        "fwd": 2.0, "bwd": 7.0, "bias": 4.0,
+    }[mode] * B * H * S * S * D
+
+    # bias rides the carry (a jit argument), NOT a closure capture — jit
+    # lowers captured jax.Arrays as embedded program constants, and a
+    # [H, S, S] f32 constant would bloat exactly the cold compile the
+    # methodology note above calls dominant.
+    init_carry = (q, k, v) if bias is None else (q, k, v, bias)
+
+    def make_step(fn):
+        causal = mode != "bias"
+        if mode == "bwd":
+            def step(carry):
+                x, kk, vv = carry
+
+                def loss(qq, kk, vv):
+                    return fn(qq, kk, vv, causal=True).astype(jnp.float32).sum()
+
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(x, kk, vv)
+                # Feed every cotangent back so none of the backward
+                # kernels can be hoisted or dead-code-eliminated.
+                return (
+                    (x + 1e-6 * dq).astype(x.dtype),
+                    (kk + 1e-6 * dk).astype(kk.dtype),
+                    (vv + 1e-6 * dv).astype(vv.dtype),
+                )
+
+            return step
+
+        def step(carry):
+            x, kk, vv, *rest = carry
+            out = fn(
+                x, kk, vv, causal=causal, bias=rest[0] if rest else None
+            ).astype(x.dtype)
+            return (out, kk, vv, *rest)
+
+        return step
+
+    n_lo, n_hi = _env_ints("TDX_FLASH_ITERS", "2,34", 2)
     if n_hi <= n_lo:
         raise ValueError(f"TDX_FLASH_ITERS: need n_hi > n_lo, got {n_lo},{n_hi}")
 
-    def bench(fn, n_lo=n_lo, n_hi=n_hi):
-        # Dynamic trip count: ONE compiled program serves both N values
-        # (fori_loop with a traced bound lowers to while_loop), so the
-        # phase pays a single Mosaic/XLA compile per attention flavor —
-        # cold compiles through the axon tunnel are the dominant cost.
+    def bench(step):
         @jax.jit
-        def g(q, k, v, n):
-            out = lax.fori_loop(
-                0, n, lambda i, x: fn(x, k, v).astype(x.dtype), q
-            )
-            return out.sum()
+        def g(carry, n):
+            out = lax.fori_loop(0, n, lambda i, c: step(c), carry)
+            return sum(leaf.sum() for leaf in jax.tree.leaves(out))
 
         lo = jnp.asarray(n_lo, jnp.int32)
         hi = jnp.asarray(n_hi, jnp.int32)
-        float(g(q, k, v, lo))  # compile + warm
-        float(g(q, k, v, hi))
+        float(g(init_carry, lo))  # compile + warm
+        float(g(init_carry, hi))
         t0 = time.perf_counter()
-        float(g(q, k, v, lo))
+        float(g(init_carry, lo))
         t_lo = time.perf_counter() - t0
         t0 = time.perf_counter()
-        float(g(q, k, v, hi))
+        float(g(init_carry, hi))
         t_hi = time.perf_counter() - t0
         return (t_hi - t_lo) / (n_hi - n_lo)
 
-    t_flash = bench(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    t_ref = bench(lambda q, k, v: default_attention(q, k, v, causal=True))
+    t_flash = bench(make_step(flash_attention))
+    t_ref = bench(make_step(default_attention))
     return {
         "flash_ms": round(t_flash * 1e3, 3),
         "ref_ms": round(t_ref * 1e3, 3),
@@ -320,6 +373,18 @@ def phase_flash() -> dict:
         "ref_tflops": round(flops / t_ref / 1e12, 2),
         "speedup": round(t_ref / t_flash, 3),
     }
+
+
+def phase_flash() -> dict:
+    return _flash_phase("fwd")
+
+
+def phase_flash_bwd() -> dict:
+    return _flash_phase("bwd")
+
+
+def phase_flash_bias() -> dict:
+    return _flash_phase("bias")
 
 
 PHASES = {
@@ -331,6 +396,8 @@ PHASES = {
     "mixtral_sharded": phase_mixtral_sharded,
     "llama70b_lower": phase_llama70b_lower,
     "flash": phase_flash,
+    "flash_bwd": phase_flash_bwd,
+    "flash_bias": phase_flash_bias,
 }
 
 
@@ -364,19 +431,27 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
         except Exception:
             err = {"error": f"unparseable phase output: {res.stdout[-200:]!r}"}
     if err is None:
-        try:
-            os.makedirs(BCACHE_DIR, exist_ok=True)
-            with open(_cache_path(name), "w") as f:
-                json.dump({
-                    "ts": time.time(),
-                    # Stamped so a CPU-forced run can never masquerade as
-                    # a hardware number at read time (legacy entries
-                    # without the stamp are treated as untrusted).
-                    "platform": os.environ.get("TDX_BENCH_PLATFORM") or "default",
-                    "result": parsed,
-                }, f)
-        except OSError:
-            pass
+        # CPU-forced results are never readable by the fallback path
+        # (_read_hw_cache rejects them), so writing one would only
+        # clobber a previous HARDWARE-stamped entry — a wedged-tunnel
+        # bench run must not destroy the last-TPU numbers it falls
+        # back on.
+        if (os.environ.get("TDX_BENCH_PLATFORM") or "default") != "cpu":
+            try:
+                os.makedirs(BCACHE_DIR, exist_ok=True)
+                with open(_cache_path(name), "w") as f:
+                    json.dump({
+                        "ts": time.time(),
+                        # Stamped so a CPU-forced run can never
+                        # masquerade as a hardware number at read time
+                        # (legacy entries without the stamp are treated
+                        # as untrusted).
+                        "platform": os.environ.get("TDX_BENCH_PLATFORM")
+                        or "default",
+                        "result": parsed,
+                    }, f)
+            except OSError:
+                pass
         return parsed
     if cache_fallback:
         cached = _read_hw_cache(name)
@@ -393,8 +468,11 @@ def _read_hw_cache(name: str):
     try:
         with open(_cache_path(name)) as f:
             cached = json.load(f)
-        if cached.get("platform") in (None, "cpu") or "t" not in cached.get(
-            "result", {}
+        result = cached.get("result", {})
+        # A real measurement carries a wall time ("t") or a per-iteration
+        # kernel time ("flash_ms" — the flash phases have no "t").
+        if cached.get("platform") in (None, "cpu") or not (
+            "t" in result or "flash_ms" in result
         ):
             return None
         return cached
@@ -540,6 +618,17 @@ def main() -> None:
             })
         else:
             out["flash_error"] = flash["error"][-160:]
+        for name in ("flash_bwd", "flash_bias"):
+            r = _run_phase(name, timeout=900.0, cache_fallback=True)
+            if "error" not in r:
+                # flash_ms -> flash_bwd_ms (not flash_bwd_flash_ms),
+                # matching the flash phase's key scheme above.
+                out.update({
+                    (f"{name}{k[5:]}" if k.startswith("flash_") else f"{name}_{k}"): v
+                    for k, v in r.items()
+                })
+            else:
+                out[f"{name}_error"] = r["error"][-160:]
 
     print(json.dumps(out))
 

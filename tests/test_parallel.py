@@ -209,6 +209,23 @@ class TestT5SequenceParallel:
         out = jax.jit(lambda p, e, d: model.apply(p, e, d))(params, enc, dec)
         assert float(jnp.abs(ref - out).max()) < 2e-4
 
+    def test_t5_with_ulysses_flash_inner(self, mesh, setup):
+        # Ulysses re-shards heads and hands the pre-sharded [H/n, S, T]
+        # bias to its inner attention — which can now be the bias-capable
+        # flash kernels, composing all-to-all sp with blockwise compute.
+        from torchdistx_tpu.models import make_t5
+        from torchdistx_tpu.ops import make_flash_attention
+
+        cfg, enc, dec, params, ref = setup
+        model = make_t5(
+            cfg,
+            attn_fn=make_ulysses_attention(
+                mesh, inner_attn=make_flash_attention(block_q=8, block_k=8)
+            ),
+        )
+        out = jax.jit(lambda p, e, d: model.apply(p, e, d))(params, enc, dec)
+        assert float(jnp.abs(ref - out).max()) < 2e-4
+
     def test_t5_with_ring_flash_attention(self, mesh, setup):
         # The bias path now runs the flash kernels per ring step (the
         # decoder's causal cross-attention transparently takes the dense
