@@ -431,27 +431,29 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
         except Exception:
             err = {"error": f"unparseable phase output: {res.stdout[-200:]!r}"}
     if err is None:
-        # CPU-forced results are never readable by the fallback path
-        # (_read_hw_cache rejects them), so writing one would only
-        # clobber a previous HARDWARE-stamped entry — a wedged-tunnel
-        # bench run must not destroy the last-TPU numbers it falls
-        # back on.
-        if (os.environ.get("TDX_BENCH_PLATFORM") or "default") != "cpu":
+        # The phase subprocess reports the backend it ACTUALLY ran on
+        # (not the env var — a silently-failed accelerator plugin would
+        # otherwise stamp a CPU run as hardware).  CPU results are never
+        # readable by the fallback path (_read_hw_cache rejects them),
+        # so writing one would only clobber a previous hardware-stamped
+        # entry — a wedged-tunnel bench run must not destroy the
+        # last-TPU numbers it falls back on.
+        backend = parsed.pop("backend", None)
+        if backend is not None and backend != "cpu":
             try:
                 os.makedirs(BCACHE_DIR, exist_ok=True)
                 with open(_cache_path(name), "w") as f:
                     json.dump({
                         "ts": time.time(),
-                        # Stamped so a CPU-forced run can never
-                        # masquerade as a hardware number at read time
-                        # (legacy entries without the stamp are treated
-                        # as untrusted).
-                        "platform": os.environ.get("TDX_BENCH_PLATFORM")
-                        or "default",
+                        "platform": backend,
                         "result": parsed,
                     }, f)
             except OSError:
                 pass
+        if backend is not None:
+            # Returned to main() so live-reported numbers can be labeled
+            # or suppressed when a phase silently ran on CPU.
+            parsed["_backend"] = backend
         return parsed
     if cache_fallback:
         cached = _read_hw_cache(name)
@@ -462,6 +464,33 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
     return err
 
 
+def _merge_cached_flash(out: dict, name: str) -> None:
+    """Attach a flash phase's last hardware measurement, age-labeled."""
+    cached = _read_hw_cache(name)
+    if cached is not None:
+        _merge_flash_result(out, name, {
+            **cached["result"],
+            "stale_s": round(time.time() - cached["ts"]),
+        })
+
+
+def _merge_flash_result(out: dict, name: str, result: dict) -> None:
+    """Merge a flash-phase result into the output JSON under the phase's
+    key scheme: flash_ms stays flash_ms for the fwd phase and becomes
+    flash_bwd_ms / flash_bias_ms for the flavors (no key stutter)."""
+    if name == "flash":
+        mapped = {
+            f"flash_{k}" if not k.startswith(("flash", "ref")) else k: v
+            for k, v in result.items()
+        }
+    else:
+        mapped = {
+            (f"{name}{k[5:]}" if k.startswith("flash_") else f"{name}_{k}"): v
+            for k, v in result.items()
+        }
+    out.update(mapped)
+
+
 def _read_hw_cache(name: str):
     """Last cached HARDWARE measurement of a phase, or None — entries
     from CPU-forced runs (or unstamped legacy ones) never qualify."""
@@ -470,8 +499,11 @@ def _read_hw_cache(name: str):
             cached = json.load(f)
         result = cached.get("result", {})
         # A real measurement carries a wall time ("t") or a per-iteration
-        # kernel time ("flash_ms" — the flash phases have no "t").
-        if cached.get("platform") in (None, "cpu") or not (
+        # kernel time ("flash_ms" — the flash phases have no "t").  Only
+        # entries stamped with a TRUE accelerator backend name qualify:
+        # "default" is the legacy env-based stamp, which a
+        # silently-failed accelerator plugin could have earned on CPU.
+        if cached.get("platform") in (None, "cpu", "default") or not (
             "t" in result or "flash_ms" in result
         ):
             return None
@@ -499,7 +531,14 @@ def _preflight_platform() -> str:
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--phase":
-        print(json.dumps(PHASES[sys.argv[2]]()))
+        res = PHASES[sys.argv[2]]()
+        try:
+            import jax  # initialized by the phase; report the TRUE backend
+
+            res.setdefault("backend", jax.default_backend())
+        except Exception:
+            pass
+        print(json.dumps(res))
         return
 
     fallback = _preflight_platform()
@@ -521,12 +560,37 @@ def main() -> None:
     if "error" in base:
         base = _run_phase("gpt2_baseline", timeout=900.0)
 
+    ours_backend = ours.pop("_backend", None)
+    base_backend = base.pop("_backend", None) if isinstance(base, dict) else None
+    forced = bool(os.environ.get("TDX_BENCH_PLATFORM"))
+    if not fallback and not forced and ours_backend == "cpu":
+        # The preflight passed (some backend had devices) but the phase
+        # actually ran on CPU — a silently-failed accelerator plugin
+        # (a user-forced TDX_BENCH_PLATFORM=cpu smoke run is NOT this).
+        # Label the run so CPU numbers can't masquerade as hardware.
+        fallback = "cpu(silent accelerator plugin failure)"
+    # If exactly one side of the headline pair ran on CPU (plugin
+    # degraded mid-session), the ratio never happened on one machine
+    # state — suppress it rather than publish an absurd speedup.
+    backends_mixed = (
+        not forced
+        and ours_backend is not None
+        and base_backend is not None
+        and (ours_backend == "cpu") != (base_backend == "cpu")
+    )
     out = {
         "metric": "gpt2-125m deferred_init→device materialize+touch wall time",
         "value": round(ours["t"], 3),
         "unit": "s",
         **({"platform": fallback} if fallback else {}),
-        "vs_baseline": round(base["t"] / ours["t"], 3) if "t" in base else None,
+        "vs_baseline": (
+            round(base["t"] / ours["t"], 3)
+            if "t" in base and not backends_mixed else None
+        ),
+        **(
+            {"backend_mismatch": f"ours={ours_backend} baseline={base_backend}"}
+            if backends_mixed else {}
+        ),
         "baseline_s": round(base.get("t", 0.0), 3),
         "ours_rss_mb": round(ours["rss_mb"], 1),
         "baseline_rss_mb": round(base.get("rss_mb", 0.0), 1),
@@ -556,8 +620,12 @@ def main() -> None:
         # Off-accelerator the 1.9B phase measures XLA CPU compile and the
         # pallas kernels run in interpreter mode — neither says anything
         # about the product.  Keep the phases that are CPU-meaningful
-        # (virtual-mesh sharded configs, host-side 70B lowering).
-        out["llama_skipped"] = out["flash_skipped"] = "accelerator unavailable"
+        # (virtual-mesh sharded configs, host-side 70B lowering); flash
+        # flavors report their last hardware measurement, age-labeled.
+        out["llama_skipped"] = "accelerator unavailable"
+        for name in ("flash", "flash_bwd", "flash_bias"):
+            out[f"{name}_skipped"] = "accelerator unavailable"
+            _merge_cached_flash(out, name)
     else:
         llama_ours = _run_phase("llama_ours", cache_fallback=True)
         if "error" not in llama_ours:
@@ -604,31 +672,28 @@ def main() -> None:
             out[f"{name}_error"] = r["error"][-160:]
 
     b70 = _run_phase("llama70b_lower", timeout=420.0)
+    b70.pop("_backend", None)  # host-side phase: backend is irrelevant
     if "error" not in b70:
         out.update({f"llama70b_{k}": v for k, v in b70.items()})
     else:
         out["llama70b_error"] = b70["error"][-160:]
 
     if not fallback:
-        flash = _run_phase("flash", timeout=900.0, cache_fallback=True)
-        if "error" not in flash:
-            out.update({
-                f"flash_{k}" if not k.startswith(("flash", "ref")) else k: v
-                for k, v in flash.items()
-            })
-        else:
-            out["flash_error"] = flash["error"][-160:]
-        for name in ("flash_bwd", "flash_bias"):
+        for name in ("flash", "flash_bwd", "flash_bias"):
             r = _run_phase(name, timeout=900.0, cache_fallback=True)
-            if "error" not in r:
-                # flash_ms -> flash_bwd_ms (not flash_bwd_flash_ms),
-                # matching the flash phase's key scheme above.
-                out.update({
-                    (f"{name}{k[5:]}" if k.startswith("flash_") else f"{name}_{k}"): v
-                    for k, v in r.items()
-                })
-            else:
+            backend = r.pop("_backend", None)
+            if "error" in r:
                 out[f"{name}_error"] = r["error"][-160:]
+            elif backend == "cpu" and not forced:
+                # Silently-degraded plugin: interpret-mode numbers say
+                # nothing about the kernels; fall back to the last
+                # hardware measurement like the preflight-fallback
+                # branch does.  (A user-forced TDX_BENCH_PLATFORM=cpu
+                # smoke run keeps its fresh interpret-mode numbers.)
+                out[f"{name}_skipped"] = "phase ran on cpu (interpret mode)"
+                _merge_cached_flash(out, name)
+            else:
+                _merge_flash_result(out, name, r)
 
     print(json.dumps(out))
 

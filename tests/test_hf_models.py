@@ -180,8 +180,13 @@ def test_deferred_then_torch_replay(name):
     assert torch.isfinite(out.logits).all()
 
 
-@pytest.mark.parametrize("name", ["gpt2", "llama", "mixtral", "t5"])
+@pytest.mark.parametrize(
+    "name", ["gpt2", "llama", "mixtral", "t5", "vit", "whisper"]
+)
 def test_deferred_then_jax_materialize_sharded(name):
+    # vit/whisper extend the sharded path beyond text: conv patch stems
+    # and encoder-decoder audio layouts shard through the same
+    # size-based plan.
     cls, cfg = _cases()[name]
     m = deferred_init(cls, cfg)
     mesh = make_mesh({"fsdp": 4, "tp": 2})
@@ -189,6 +194,10 @@ def test_deferred_then_jax_materialize_sharded(name):
     assert params
     for k, v in params.items():
         assert np.isfinite(np.asarray(v)).all(), k
+    assert any(
+        not getattr(v.sharding, "is_fully_replicated", True)
+        for v in params.values()
+    ), "no parameter actually sharded"
 
 
 def test_eager_parity_llama():
