@@ -630,12 +630,29 @@ def main() -> None:
         llama_ours = _run_phase("llama_ours", cache_fallback=True)
         if "error" not in llama_ours:
             llama_base = _run_phase("llama_baseline", cache_fallback=True)
+            lo_backend = llama_ours.pop("_backend", None)
+            lb_backend = llama_base.pop("_backend", None)
+            # Same mixed-backend guard as the headline pair: if exactly
+            # one side silently ran on CPU, suppress the ratio.
+            l_mixed = (
+                not forced
+                and lo_backend is not None
+                and lb_backend is not None
+                and (lo_backend == "cpu") != (lb_backend == "cpu")
+            )
             out["llama_1p9b_ours_s"] = round(llama_ours["t"], 3)
             out["llama_1p9b_ours_rss_mb"] = round(llama_ours["rss_mb"], 1)
             out["llama_1p9b_n_params"] = llama_ours.get("n_params")
+            if not forced and lo_backend == "cpu":
+                out["llama_1p9b_platform"] = "cpu(silent accelerator plugin failure)"
             if "stale_s" in llama_ours:
                 out["llama_1p9b_stale_s"] = llama_ours["stale_s"]
-            if "error" not in llama_base:
+            if "error" not in llama_base and l_mixed:
+                out["llama_1p9b_backend_mismatch"] = (
+                    f"ours={lo_backend} baseline={lb_backend}"
+                )
+                out["llama_1p9b_baseline_s"] = round(llama_base["t"], 3)
+            elif "error" not in llama_base:
                 out["llama_1p9b_baseline_s"] = round(llama_base["t"], 3)
                 out["llama_1p9b_baseline_rss_mb"] = round(llama_base["rss_mb"], 1)
                 out["llama_1p9b_vs_baseline"] = round(
