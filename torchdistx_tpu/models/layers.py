@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import MoEConfig, TransformerConfig
+from ..ops.segments import normalize_segment_ids
 
 AttnFn = Callable[..., jax.Array]
 
@@ -34,9 +35,14 @@ def default_attention(
     *,
     causal: bool = True,
     bias: Optional[jax.Array] = None,
+    segment_ids=None,  # [B, S] or ([B, S], [B, T]): packed sequences
 ) -> jax.Array:
-    """Plain XLA attention with GQA head-group broadcasting, f32 softmax."""
+    """Plain XLA attention with GQA head-group broadcasting, f32 softmax.
+
+    ``segment_ids`` masks cross-segment pairs (packed-document training):
+    query i attends key j only when their segment ids are equal."""
     B, S, H, D = q.shape
+    T = k.shape[1]
     KV = k.shape[2]
     groups = H // KV
     qf = q.astype(jnp.float32) * (1.0 / math.sqrt(D))
@@ -50,10 +56,15 @@ def default_attention(
             logits = logits + bias[None, :, None]  # broadcast over (kv, g)
         else:
             logits = logits + bias.reshape(1, KV, groups, *bias.shape[-2:])
+    mask = None
     if causal:
-        T = k.shape[1]
-        mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        mask = jnp.tril(jnp.ones((S, T), dtype=bool), k=T - S)[None, None, None]
+    if segment_ids is not None:
+        q_seg, kv_seg = normalize_segment_ids(segment_ids, B, S, T)
+        seg = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None, None]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, vf)
     return out.reshape(B, S, H, D).astype(q.dtype)

@@ -51,6 +51,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .segments import normalize_segment_ids
+
 _NEG = -1e30
 _LANES = 128  # TPU lane width: scratch vectors are carried at full lanes
 
@@ -72,6 +74,14 @@ def _block_needed(q_start, k_start, block_q, offset, causal):
     )
 
 
+def _seg_mask(qseg_ref, kseg_ref):
+    """[bq, bk] same-segment mask from the lane-broadcast id carriers
+    (packed-sequence training: cross-segment pairs never attend)."""
+    qs = qseg_ref[0][:, :1]  # [bq, 1] int32
+    ks = kseg_ref[0][:, :1]  # [bk, 1]
+    return qs == jnp.transpose(ks)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -82,6 +92,7 @@ def _fwd_kernel(
     k_ref,  # [1, block_k, D]
     v_ref,  # [1, block_k, D]
     *rest,  # [bias_ref [1, block_q, block_k] if has_bias,]
+    #         [qseg_ref / kseg_ref [1, block, _LANES] i32 if has_segs,]
     #         o_ref [1, block_q, D],
     #         lse_ref [1, block_q, _LANES] (lse broadcast across full
     #           lanes, the upstream TPU flash layout — a 1-wide minor dim
@@ -95,12 +106,12 @@ def _fwd_kernel(
     seq_len_k: int,
     offset: int,
     has_bias: bool = False,
+    has_segs: bool = False,
 ):
-    if has_bias:
-        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        bias_ref = None
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    segs = (rest.pop(0), rest.pop(0)) if has_segs else None
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -126,6 +137,8 @@ def _fwd_kernel(
         mask = _causal_mask(
             q_start, k_start, block_q, block_k, seq_len_k, offset, causal
         )
+        if segs is not None:
+            mask = jnp.logical_and(mask, _seg_mask(*segs))
         s = jnp.where(mask, s, _NEG)
 
         m_prev = m_ref[:, :1]  # [bq, 1]
@@ -161,7 +174,7 @@ def _fwd_kernel(
 
 def _block_p_ds(
     q, k, lse, do, v, delta, *, causal, sm_scale, q_start, k_start, seq_len_k,
-    offset, block_q, block_k, bias=None,
+    offset, block_q, block_k, bias=None, seg_mask=None,
 ):
     """Recompute one block's probabilities and d(logits) from residuals.
 
@@ -179,6 +192,8 @@ def _block_p_ds(
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     mask = _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal)
+    if seg_mask is not None:
+        mask = jnp.logical_and(mask, seg_mask)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -196,12 +211,12 @@ def _bwd_dq_kernel(
     seq_len_k: int,
     offset: int,
     has_bias: bool = False,
+    has_segs: bool = False,
 ):
-    if has_bias:
-        bias_ref, dq_ref, dq_acc = rest  # dq_acc: VMEM [block_q, D] f32
-    else:
-        bias_ref = None
-        dq_ref, dq_acc = rest
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    segs = (rest.pop(0), rest.pop(0)) if has_segs else None
+    dq_ref, dq_acc = rest  # dq_acc: VMEM [block_q, D] f32
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -224,6 +239,7 @@ def _bwd_dq_kernel(
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
             bias=None if bias_ref is None else bias_ref[0],
+            seg_mask=None if segs is None else _seg_mask(*segs),
         )
         dq_acc[:] += jax.lax.dot_general(
             ds,
@@ -247,15 +263,15 @@ def _bwd_dkv_kernel(
     offset: int,
     groups: int,
     has_bias: bool = False,
+    has_segs: bool = False,
 ):
     """Grid (B*KV, nk, groups*nq): the innermost dimension walks every
     (group head, q block) pair of this kv head, accumulating dk/dv in
     VMEM — GQA needs no K/V broadcast or post-hoc group reduction."""
-    if has_bias:
-        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
-    else:
-        bias_ref = None
-        dk_ref, dv_ref, dk_acc, dv_acc = rest  # accs: VMEM [block_k, D] f32
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    segs = (rest.pop(0), rest.pop(0)) if has_segs else None
+    dk_ref, dv_ref, dk_acc, dv_acc = rest  # accs: VMEM [block_k, D] f32
     kj = pl.program_id(1)
     it = pl.program_id(2)
     n_inner = pl.num_programs(2)
@@ -283,6 +299,7 @@ def _bwd_dkv_kernel(
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
             bias=None if bias_ref is None else bias_ref[0],
+            seg_mask=None if segs is None else _seg_mask(*segs),
         )
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -298,20 +315,22 @@ def _bwd_dkv_kernel(
 
 
 def _dbias_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, dbias_ref,
-    acc_ref,  # VMEM [block_q, block_k] f32
-    *,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, *rest,
     causal: bool,
     sm_scale: float,
     block_q: int,
     block_k: int,
     seq_len_k: int,
     offset: int,
+    has_segs: bool = False,
 ):
     """Grid (H, nq, nk, B), batch innermost: the output block (h, qi, kj)
     is constant across the inner loop, so each batch's ``p * (dp - delta)``
     accumulates in VMEM and the block is written exactly once — the bias
     gradient never materializes per-batch [S, T] planes."""
+    rest = list(rest)
+    segs = (rest.pop(0), rest.pop(0)) if has_segs else None
+    dbias_ref, acc_ref = rest  # acc: VMEM [block_q, block_k] f32
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     b = pl.program_id(3)
@@ -335,6 +354,7 @@ def _dbias_kernel(
             causal=causal, sm_scale=sm_scale, q_start=q_start, k_start=k_start,
             seq_len_k=seq_len_k, offset=offset, block_q=block_q, block_k=block_k,
             bias=bias_ref[0],
+            seg_mask=None if segs is None else _seg_mask(*segs),
         )
         acc_ref[:] += ds * (1.0 / sm_scale)  # d(logits) without the q scale
 
@@ -388,9 +408,28 @@ def _bias_spec(Hb, H, block_q, block_k):
     return pl.BlockSpec((1, block_q, block_k), lambda bh, qi, kj: (bh % H, qi, kj))
 
 
+def _seg_carrier(seg: jax.Array, block: int) -> jax.Array:
+    """[B, S] int32 ids, zero-padded to a block multiple and broadcast to
+    full lane width (the same row-carrier layout as lse/delta; kernels
+    read lane 0).  Padded rows are provably inert: padded q rows carry
+    zero ``do``/``delta`` and padded key columns are masked by
+    ``seq_len_k``, so their contributions vanish regardless of id."""
+    segp = _pad_seq(seg.astype(jnp.int32), block)
+    return jnp.broadcast_to(segp[:, :, None], (*segp.shape, _LANES))
+
+
+def _seg_specs(heads, block_q, block_k):
+    """(q, k) carrier BlockSpecs for the (bh, qi, kj) grids: the batch
+    row is bh // heads (ids are per-batch, shared by every head)."""
+    return (
+        pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kj: (bh // heads, qi, 0)),
+        pl.BlockSpec((1, block_k, _LANES), lambda bh, qi, kj: (bh // heads, kj, 0)),
+    )
+
+
 def _fwd_call(
     qh, kh, vh, groups, causal, block_q, block_k, interpret,
-    bias=None, heads=None,
+    bias=None, heads=None, segs=None,
 ):
     BH, S, D = qh.shape
     T = kh.shape[1]
@@ -408,12 +447,17 @@ def _fwd_call(
     if bias is not None:
         in_specs.append(_bias_spec(bias.shape[0], heads, block_q, block_k))
         operands.append(_pad_bias(bias, block_q, block_k))
+    if segs is not None:
+        in_specs.extend(_seg_specs(heads, block_q, block_k))
+        operands.extend(
+            [_seg_carrier(segs[0], block_q), _seg_carrier(segs[1], block_k)]
+        )
 
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
-            has_bias=bias is not None,
+            has_bias=bias is not None, has_segs=segs is not None,
         ),
         grid=(BH, nq, nk),
         in_specs=in_specs,
@@ -440,7 +484,7 @@ def _fwd_call(
 
 def _bwd_call(
     qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
-    delta3=None, bias=None, heads=None, want_dbias=False,
+    delta3=None, bias=None, heads=None, segs=None, want_dbias=False,
 ):
     BH, S, D = qh.shape
     T = kh.shape[1]
@@ -456,6 +500,10 @@ def _bwd_call(
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
     biasp = None if bias is None else _pad_bias(bias, block_q, block_k)
     Hb = None if bias is None else bias.shape[0]
+    segc = (
+        None if segs is None
+        else (_seg_carrier(segs[0], block_q), _seg_carrier(segs[1], block_k))
+    )
 
     common = dict(
         causal=causal, sm_scale=sm_scale,
@@ -476,8 +524,14 @@ def _bwd_call(
     if bias is not None:
         dq_specs.append(_bias_spec(Hb, heads, block_q, block_k))
         dq_operands.append(biasp)
+    if segc is not None:
+        dq_specs.extend(_seg_specs(heads, block_q, block_k))
+        dq_operands.extend(segc)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, has_bias=bias is not None, **common),
+        functools.partial(
+            _bwd_dq_kernel, has_bias=bias is not None,
+            has_segs=segc is not None, **common,
+        ),
         grid=(BH, nq, nk),
         in_specs=dq_specs,
         out_specs=qspec,
@@ -487,7 +541,9 @@ def _bwd_call(
     )(*dq_operands)
 
     # Query-head row for (kv head bkv, group g) is bkv*groups + g; the
-    # innermost grid dim packs (g, qi) as it = g*nq + qi.
+    # innermost grid dim packs (g, qi) as it = g*nq + qi.  Batch item:
+    # bkv // KV, with KV = kv heads per item.
+    KV = BKV // (BH // heads) if heads else None
     kspec = pl.BlockSpec((1, block_k, D), lambda bkv, kj, it: (bkv, kj, 0))
     qspec2 = pl.BlockSpec(
         (1, block_q, D), lambda bkv, kj, it: (bkv * groups + it // nq, it % nq, 0)
@@ -499,9 +555,7 @@ def _bwd_call(
     dkv_specs = [qspec2, kspec, kspec, qspec2, rowspec2, rowspec2]
     dkv_operands = [qp, kp, vp, dop, lsep, dp]
     if bias is not None:
-        # Head within the batch item for (kv head bkv, group g):
-        # (bkv % KV) * groups + g, with KV = kv heads per item.
-        KV = BKV // (BH // heads)
+        # Head within the batch item: (bkv % KV) * groups + g.
         if Hb == 1:
             bspec2 = pl.BlockSpec(
                 (1, block_q, block_k), lambda bkv, kj, it: (0, it % nq, kj)
@@ -513,9 +567,20 @@ def _bwd_call(
             )
         dkv_specs.append(bspec2)
         dkv_operands.append(biasp)
+    if segc is not None:
+        dkv_specs.extend([
+            pl.BlockSpec(
+                (1, block_q, _LANES), lambda bkv, kj, it: (bkv // KV, it % nq, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, _LANES), lambda bkv, kj, it: (bkv // KV, kj, 0)
+            ),
+        ])
+        dkv_operands.extend(segc)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, groups=groups, has_bias=bias is not None, **common
+            _bwd_dkv_kernel, groups=groups, has_bias=bias is not None,
+            has_segs=segc is not None, **common,
         ),
         grid=(BKV, nk, groups * nq),
         in_specs=dkv_specs,
@@ -534,14 +599,15 @@ def _bwd_call(
     if not want_dbias:
         return dq[:, :S], dk[:, :T], dv[:, :T]
     dbias = _dbias_call(
-        qp, kp, vp, dop, lsep, dp, biasp, groups, heads, interpret, S, T, **common
+        qp, kp, vp, dop, lsep, dp, biasp, groups, heads, interpret, S, T,
+        segc=segc, **common,
     )
     return dq[:, :S], dk[:, :T], dv[:, :T], dbias
 
 
 def _dbias_call(
     qp, kp, vp, dop, lsep, dp, biasp, groups, heads, interpret, S, T,
-    *, causal, sm_scale, block_q, block_k, seq_len_k, offset,
+    segc=None, *, causal, sm_scale, block_q, block_k, seq_len_k, offset,
 ):
     """Bias gradient at padded [Hb, Sq_p, Tk_p].  Padded rows and columns
     contribute exactly zero (do rows are zero-padded, key columns are
@@ -564,6 +630,8 @@ def _dbias_call(
         qmap = lambda h, qi, kj, ib: (ib, qi, 0)
         kmap = lambda h, qi, kj, ib: ((ib // H) * KV + (ib % H) // groups, kj, 0)
         bmap = lambda h, qi, kj, ib: (0, qi, kj)
+        qsmap = lambda h, qi, kj, ib: (ib // H, qi, 0)
+        ksmap = lambda h, qi, kj, ib: (ib // H, kj, 0)
     else:
         # Grid (H, nq, nk, B) with batch innermost; query-head row of
         # (h, b) is b*H + h, its kv row b*KV + h//groups.
@@ -571,26 +639,37 @@ def _dbias_call(
         qmap = lambda h, qi, kj, b: (b * H + h, qi, 0)
         kmap = lambda h, qi, kj, b: (b * KV + h // groups, kj, 0)
         bmap = lambda h, qi, kj, b: (h, qi, kj)
+        qsmap = lambda h, qi, kj, b: (b, qi, 0)
+        ksmap = lambda h, qi, kj, b: (b, kj, 0)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), qmap),
+        pl.BlockSpec((1, block_k, D), kmap),
+        pl.BlockSpec((1, block_k, D), kmap),
+        pl.BlockSpec((1, block_q, D), qmap),
+        pl.BlockSpec((1, block_q, _LANES), qmap),
+        pl.BlockSpec((1, block_q, _LANES), qmap),
+        pl.BlockSpec((1, block_q, block_k), bmap),
+    ]
+    operands = [qp, kp, vp, dop, lsep, dp, biasp]
+    if segc is not None:
+        in_specs.extend([
+            pl.BlockSpec((1, block_q, _LANES), qsmap),
+            pl.BlockSpec((1, block_k, _LANES), ksmap),
+        ])
+        operands.extend(segc)
     dbias = pl.pallas_call(
         functools.partial(
             _dbias_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, seq_len_k=seq_len_k, offset=offset,
+            has_segs=segc is not None,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), qmap),
-            pl.BlockSpec((1, block_k, D), kmap),
-            pl.BlockSpec((1, block_k, D), kmap),
-            pl.BlockSpec((1, block_q, D), qmap),
-            pl.BlockSpec((1, block_q, _LANES), qmap),
-            pl.BlockSpec((1, block_q, _LANES), qmap),
-            pl.BlockSpec((1, block_q, block_k), bmap),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, block_k), bmap),
         out_shape=jax.ShapeDtypeStruct((Hb, qp.shape[1], kp.shape[1]), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, dp, biasp)
+    )(*operands)
     return dbias[:, :S, :T]
 
 
@@ -599,43 +678,48 @@ def _dbias_call(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_core(qh, kh, vh, bias, groups, heads, causal, block_q, block_k,
-                interpret):
-    """One differentiable core for both call shapes: ``bias`` is either a
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_core(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+                block_q, block_k, interpret):
+    """One differentiable core for every call shape: ``bias`` is either a
     [Hb, S, T] array or ``None`` (an empty pytree — its cotangent is
-    ``None`` and the dbias pass is skipped)."""
+    ``None`` and the dbias pass is skipped); ``qseg``/``kseg`` are
+    [B, S]/[B, T] int32 segment ids or ``None`` (integer operands, zero
+    cotangent)."""
     out, _ = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads,
+        segs=None if qseg is None else (qseg, kseg),
     )
     return out
 
 
-def _flash_core_fwd(qh, kh, vh, bias, groups, heads, causal, block_q,
-                    block_k, interpret):
+def _flash_core_fwd(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+                    block_q, block_k, interpret):
     out, lse = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads,
+        segs=None if qseg is None else (qseg, kseg),
     )
-    return out, (qh, kh, vh, bias, out, lse)
+    return out, (qh, kh, vh, bias, qseg, kseg, out, lse)
 
 
 def _flash_core_bwd(groups, heads, causal, block_q, block_k, interpret,
                     res, do):
-    qh, kh, vh, bias, out, lse = res
+    qh, kh, vh, bias, qseg, kseg, out, lse = res
+    segs = None if qseg is None else (qseg, kseg)
     if bias is None:
         dq, dk, dv = _bwd_call(
             qh, kh, vh, do, out, lse, groups, causal, block_q, block_k,
-            interpret,
+            interpret, heads=heads, segs=segs,
         )
-        return dq, dk, dv, None
+        return dq, dk, dv, None, None, None
     dq, dk, dv, dbias = _bwd_call(
         qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
-        bias=bias, heads=heads, want_dbias=True,
+        bias=bias, heads=heads, segs=segs, want_dbias=True,
     )
     # (a head-broadcast bias already accumulated over heads in-kernel)
-    return dq, dk, dv, dbias.astype(bias.dtype)
+    return dq, dk, dv, dbias.astype(bias.dtype), None, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -653,6 +737,7 @@ def flash_attention(
     *,
     causal: bool = True,
     bias: Optional[jax.Array] = None,
+    segment_ids=None,  # [B, S] or ([B, S], [B, T]): packed sequences
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -664,6 +749,14 @@ def flash_attention(
     convention — shape ``[H or 1, S, T]`` — and runs in the kernels
     (fwd, dq/dk/dv recompute, and a dedicated dbias kernel), not via an
     XLA fallback.
+
+    ``segment_ids`` masks cross-segment pairs in-kernel (packed-document
+    training): int32 ids, [B, S] for self-attention or a
+    ``([B, S], [B, T])`` pair for cross-attention.  The id carriers ride
+    the lse/delta lane-broadcast layout, so the masking is blockwise too.
+    A query whose segment contains no keys at all gets a zero output row
+    (the XLA path softmaxes over the uniform -1e30 logits instead —
+    don't build packings with empty segments).
     """
     B, S, H, D = q.shape
     T, KV = k.shape[1], k.shape[2]
@@ -708,16 +801,22 @@ def flash_attention(
             # callers should pass the full [H, S, T] bias (T5 does) or
             # fold position terms into q/k instead.
             bias = jnp.broadcast_to(bias, (bias.shape[0], S, T))
-    out = _flash_core(qh, kh, vh, bias, groups, H, causal, bq, bk, interpret)
+    qseg = kseg = None
+    if segment_ids is not None:
+        qseg, kseg = normalize_segment_ids(segment_ids, B, S, T)
+    out = _flash_core(
+        qh, kh, vh, bias, qseg, kseg, groups, H, causal, bq, bk, interpret
+    )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
 def make_flash_attention(*, block_q: int = 1024, block_k: int = 1024):
     """An ``AttnFn`` with fixed block sizes, for model constructors."""
 
-    def attn_fn(q, k, v, *, causal=True, bias=None):
+    def attn_fn(q, k, v, *, causal=True, bias=None, segment_ids=None):
         return flash_attention(
-            q, k, v, causal=causal, bias=bias, block_q=block_q, block_k=block_k
+            q, k, v, causal=causal, bias=bias, segment_ids=segment_ids,
+            block_q=block_q, block_k=block_k,
         )
 
     return attn_fn

@@ -4,10 +4,12 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
+
+from ..ops.segments import normalize_segment_ids
 
 
 def wrap_seq_parallel_attn(
@@ -15,22 +17,29 @@ def wrap_seq_parallel_attn(
     *,
     name: str,
     spec: P,
-    per_device: Callable,  # (q, k, v, causal, bias) -> out, inside shard_map
+    per_device: Callable,  # (q, k, v, causal, bias, segs) -> out, in shard_map
     validate: Optional[Callable] = None,  # (q, k, v) -> None, raises on misuse
     bias_spec: Optional[P] = None,  # how [H, S_q, S_k] bias shards, or None
+    seg_specs: Optional[Tuple[P, P]] = None,  # (q_seg, kv_seg) sharding
 ):
     """Build a model-facing ``AttnFn`` that shard_maps ``per_device``.
 
     Global [B, S, H, D] arrays are partitioned by ``spec``; one shard_map
-    is built per (causality, has-bias) so the mapped callable stays
-    jit-cacheable.  Additive [H, S_q, S_k] bias is partitioned by
+    is built per (causality, has-bias, has-segs) so the mapped callable
+    stays jit-cacheable.  Additive [H, S_q, S_k] bias is partitioned by
     ``bias_spec`` when the strategy supports it (ring attention shards the
-    query rows and block-slices the key columns); strategies that cannot
-    reshard a bias leave ``bias_spec=None`` and reject it.
+    query rows and block-slices the key columns); packed-sequence
+    ``segment_ids`` — normalized to a ``(q_seg [B, S], kv_seg [B, T])``
+    pair — are partitioned by ``seg_specs``.  Strategies that cannot
+    reshard an operand leave its spec ``None`` and reject it.
     """
 
-    def _build(causal: bool, with_bias: bool):
-        in_specs = (spec, spec, spec) + ((bias_spec,) if with_bias else ())
+    def _build(causal: bool, with_bias: bool, with_segs: bool):
+        in_specs = (
+            (spec, spec, spec)
+            + ((bias_spec,) if with_bias else ())
+            + (seg_specs if with_segs else ())
+        )
 
         @partial(
             shard_map,
@@ -39,21 +48,36 @@ def wrap_seq_parallel_attn(
             out_specs=spec,
             check_vma=False,
         )
-        def _sharded(q, k, v, *maybe_bias):
-            return per_device(q, k, v, causal, maybe_bias[0] if maybe_bias else None)
+        def _sharded(q, k, v, *extras):
+            extras = list(extras)
+            bias = extras.pop(0) if with_bias else None
+            segs = tuple(extras) if with_segs else None
+            return per_device(q, k, v, causal, bias, segs)
 
         return _sharded
 
     fns = {}
 
-    def attn_fn(q, k, v, *, causal=True, bias=None):
+    def attn_fn(q, k, v, *, causal=True, bias=None, segment_ids=None):
         if bias is not None and bias_spec is None:
             raise NotImplementedError(f"{name} does not support bias")
+        if segment_ids is not None and seg_specs is None:
+            raise NotImplementedError(f"{name} does not support segment_ids")
         if validate is not None:
             validate(q, k, v)
-        key = (causal, bias is not None)
+        segs = None
+        if segment_ids is not None:
+            segs = normalize_segment_ids(
+                segment_ids, q.shape[0], q.shape[1], k.shape[1]
+            )
+        key = (causal, bias is not None, segs is not None)
         if key not in fns:
             fns[key] = _build(*key)
-        return fns[key](q, k, v) if bias is None else fns[key](q, k, v, bias)
+        args = (q, k, v)
+        if bias is not None:
+            args += (bias,)
+        if segs is not None:
+            args += segs
+        return fns[key](*args)
 
     return attn_fn

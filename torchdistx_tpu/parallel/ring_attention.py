@@ -40,6 +40,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     bias: Optional[jax.Array] = None,
+    segment_ids=None,  # (q_seg [B, s] local, kv_seg [B, T_total])
 ) -> jax.Array:
     """Exact attention over the ring; call inside ``shard_map``.
 
@@ -47,6 +48,10 @@ def ring_attention(
     the *query* rows: local shape [H, s, T_total].  Each ring step slices
     the key-block columns out of it — O(H·s·T/n) memory per device, no
     rotation needed since the full key extent is resident per row strip.
+
+    ``segment_ids`` (packed sequences) follow the same scheme: the query
+    ids are row-sharded [B, s], the key ids fully resident [B, T_total]
+    and column-sliced per step.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -75,13 +80,18 @@ def ring_attention(
             k_pos = src * t + jnp.arange(t)
             offset = (t - s) * n
             mask = (q_pos[:, None] + offset >= k_pos[None, :]).astype(jnp.float32)
-            logits = jnp.where(mask[None, None, None].astype(bool), logits, _NEG)
         else:
             mask = jnp.ones((s, t), jnp.float32)
+        mask = jnp.broadcast_to(mask[None], (B, s, t))
+        if segment_ids is not None:
+            q_seg, kv_seg = segment_ids
+            ks_blk = lax.dynamic_slice_in_dim(kv_seg, src * t, t, axis=1)
+            mask = mask * (q_seg[:, :, None] == ks_blk[:, None, :])
+        logits = jnp.where(mask[:, None, None].astype(bool), logits, _NEG)
         blk_max = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)
-        p = jnp.exp(logits - new_m[..., None]) * mask[None, None, None]
+        p = jnp.exp(logits - new_m[..., None]) * mask[:, None, None]
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * corr[..., None] + jnp.einsum(
             "bkgst,btkd->bkgsd", p, v_cur.astype(jnp.float32)
@@ -123,7 +133,10 @@ def make_ring_attention(
         # [H, S_q, S_k] bias: heads over tp, query rows over sp, full key
         # extent resident (ring steps slice the key-block columns).
         bias_spec=P(h, seq_axis, None),
-        per_device=lambda q, k, v, causal, bias: ring_attention(
-            q, k, v, axis_name=seq_axis, causal=causal, bias=bias
+        # (q_seg, kv_seg): query ids row-sharded, key ids fully resident.
+        seg_specs=(P(b, seq_axis), P(b, None)),
+        per_device=lambda q, k, v, causal, bias, segs: ring_attention(
+            q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
+            segment_ids=segs,
         ),
     )

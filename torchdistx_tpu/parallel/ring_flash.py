@@ -60,17 +60,18 @@ def _merge(o, lse, o_i, lse_i):
 
 def _ring_fwd_loop(
     qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
-    bias=None, heads=None,
+    bias=None, heads=None, segs=None,
 ):
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     BH, s, D = qh.shape
     t = kh.shape[1]
 
-    def flash_block(k_cur, v_cur, blk_causal, bias_blk=None):
+    def flash_block(k_cur, v_cur, blk_causal, bias_blk=None, seg_blk=None):
         out, lse3 = _fwd_call(
             qh, k_cur, v_cur, groups, blk_causal, bq, bk, interpret,
             bias=bias_blk, heads=heads,
+            segs=None if seg_blk is None else (segs[0], seg_blk),
         )
         return out.astype(jnp.float32), lse3[:, :s, 0]
 
@@ -78,27 +79,32 @@ def _ring_fwd_loop(
         o, lse, k_cur, v_cur = carry
         src = (idx - i) % n  # which global key block k_cur holds
         # Bias rides row-sharded [H, s, T_total]; slice this step's
-        # key-block columns (same scheme as the dense ring).
+        # key-block columns (same scheme as the dense ring).  Segment ids
+        # likewise: query ids local, key ids resident and column-sliced.
         blk = (
             None if bias is None
             else lax.dynamic_slice_in_dim(bias, src * t, t, axis=2)
         )
+        seg_blk = (
+            None if segs is None
+            else lax.dynamic_slice_in_dim(segs[1], src * t, t, axis=1)
+        )
         if causal:
-            # (blk may be statically None — an empty pytree operand)
+            # (blk/seg_blk may be statically None — empty pytree operands)
             o_i, lse_i = lax.switch(
                 jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
                 [
-                    lambda kv: flash_block(kv[0], kv[1], False, kv[2]),  # past
-                    lambda kv: flash_block(kv[0], kv[1], True, kv[2]),  # diagonal
+                    lambda kv: flash_block(kv[0], kv[1], False, kv[2], kv[3]),
+                    lambda kv: flash_block(kv[0], kv[1], True, kv[2], kv[3]),
                     lambda kv: (  # future: contributes nothing
                         jnp.zeros((BH, s, D), jnp.float32),
                         jnp.full((BH, s), _NEG, jnp.float32),
                     ),
                 ],
-                (k_cur, v_cur, blk),
+                (k_cur, v_cur, blk, seg_blk),
             )
         else:
-            o_i, lse_i = flash_block(k_cur, v_cur, False, blk)
+            o_i, lse_i = flash_block(k_cur, v_cur, False, blk, seg_blk)
         o, lse = _merge(o, lse, o_i, lse_i)
         return o, lse, ppermute_next(k_cur, axis_name), ppermute_next(v_cur, axis_name)
 
@@ -108,32 +114,37 @@ def _ring_fwd_loop(
     return o.astype(qh.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _ring_flash(qh, kh, vh, bias, groups, heads, causal, axis_name, bq, bk,
-                interpret):
-    """One differentiable ring for both call shapes: ``bias`` is either a
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _ring_flash(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+                axis_name, bq, bk, interpret):
+    """One differentiable ring for every call shape: ``bias`` is either a
     row-sharded [Hb, s, T_total] array or ``None`` (an empty pytree —
-    its cotangent is ``None`` and the dbias strips are skipped)."""
+    its cotangent is ``None`` and the dbias strips are skipped);
+    ``qseg``/``kseg`` are [B, s] local / [B, T_total] resident segment
+    ids or ``None`` (integer operands, zero cotangent)."""
     out, _ = _ring_fwd_loop(
         qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
         bias=bias, heads=heads,
+        segs=None if qseg is None else (qseg, kseg),
     )
     return out
 
 
-def _ring_flash_fwd(qh, kh, vh, bias, groups, heads, causal, axis_name,
-                    bq, bk, interpret):
+def _ring_flash_fwd(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+                    axis_name, bq, bk, interpret):
     out, lse = _ring_fwd_loop(
         qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
         bias=bias, heads=heads,
+        segs=None if qseg is None else (qseg, kseg),
     )
-    return out, (qh, kh, vh, bias, out, lse)
+    return out, (qh, kh, vh, bias, qseg, kseg, out, lse)
 
 
 def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
                     res, do):
-    qh, kh, vh, bias, out, lse = res
+    qh, kh, vh, bias, qseg, kseg, out, lse = res
     has_bias = bias is not None
+    has_segs = qseg is not None
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     BH, s, D = qh.shape
@@ -152,10 +163,11 @@ def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
     lse3 = jnp.broadcast_to(lse_p[:, :, None], (BH, lse_p.shape[1], _LANES))
     delta3 = _delta_carrier(do, out, bq, lse3.shape)
 
-    def grads_block(k_cur, v_cur, blk_causal, bias_blk):
+    def grads_block(k_cur, v_cur, blk_causal, bias_blk, seg_blk):
         r = _bwd_call(
             qh, k_cur, v_cur, do, out, lse3, groups, blk_causal, bq, bk,
             interpret, delta3=delta3, bias=bias_blk, heads=heads,
+            segs=None if seg_blk is None else (qseg, seg_blk),
             want_dbias=has_bias,
         )
         return (
@@ -180,18 +192,22 @@ def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
             lax.dynamic_slice_in_dim(bias, src * t, t, axis=2)
             if has_bias else None
         )
+        seg_blk = (
+            lax.dynamic_slice_in_dim(kseg, src * t, t, axis=1)
+            if has_segs else None
+        )
         if causal:
             dq_i, dk_i, dv_i, db_i = lax.switch(
                 jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2)),
                 [
-                    lambda kv: grads_block(kv[0], kv[1], False, kv[2]),
-                    lambda kv: grads_block(kv[0], kv[1], True, kv[2]),
+                    lambda kv: grads_block(kv[0], kv[1], False, kv[2], kv[3]),
+                    lambda kv: grads_block(kv[0], kv[1], True, kv[2], kv[3]),
                     zeros_block,  # future: contributes nothing
                 ],
-                (k_cur, v_cur, blk),
+                (k_cur, v_cur, blk, seg_blk),
             )
         else:
-            dq_i, dk_i, dv_i, db_i = grads_block(k_cur, v_cur, False, blk)
+            dq_i, dk_i, dv_i, db_i = grads_block(k_cur, v_cur, False, blk, seg_blk)
         dq = dq + dq_i
         if has_bias:
             # Each global key block is visited exactly once per cycle, so
@@ -223,6 +239,8 @@ def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
         dk.astype(kh.dtype),
         dv.astype(vh.dtype),
         dbias.astype(bias.dtype) if has_bias else None,
+        None,  # qseg: integer operand, zero cotangent
+        None,  # kseg
     )
 
 
@@ -237,6 +255,7 @@ def ring_flash_attention(
     axis_name: str = "sp",
     causal: bool = True,
     bias: Optional[jax.Array] = None,  # [H or 1, s, T_total] row-sharded
+    segment_ids=None,  # (q_seg [B, s] local, kv_seg [B, T_total])
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
@@ -251,7 +270,9 @@ def ring_flash_attention(
     ``bias`` (additive, T5-style) arrives sharded over the query rows with
     the full key extent resident, exactly like the dense ring; each step
     slices this step's key-block columns and runs them through the
-    bias-enabled flash kernels (including dbias in the backward)."""
+    bias-enabled flash kernels (including dbias in the backward).
+    ``segment_ids`` (packed sequences) follow the same scheme: query ids
+    row-sharded [B, s], key ids fully resident [B, T_total]."""
     B, s, H, D = q.shape
     t, KV = k.shape[1], k.shape[2]
     if H % KV:
@@ -290,8 +311,18 @@ def ring_flash_attention(
                 f"({bk}) must be a multiple of {_LANES} (or >= the local "
                 f"key chunk t={t}); Mosaic rejects narrower minor block dims."
             )
-    out = _ring_flash(qh, kh, vh, bias, groups, H, causal, axis_name, bq, bk,
-                      interpret)
+    qseg = kseg = None
+    if segment_ids is not None:
+        n = lax.psum(1, axis_name)
+        qseg, kseg = segment_ids
+        if tuple(qseg.shape) != (B, s) or tuple(kseg.shape) != (B, n * t):
+            raise ValueError(
+                f"ring segment_ids must be (q_seg [B, s]=[{B}, {s}] local, "
+                f"kv_seg [B, T_total]=[{B}, {n * t}] resident), got "
+                f"{tuple(qseg.shape)} / {tuple(kseg.shape)}."
+            )
+    out = _ring_flash(qh, kh, vh, bias, qseg, kseg, groups, H, causal,
+                      axis_name, bq, bk, interpret)
     return out.reshape(B, H, s, D).transpose(0, 2, 1, 3)
 
 
@@ -323,16 +354,17 @@ def make_ring_flash_attention(
     b = tuple(a for a in batch_axes if a in present) or None
     h = tuple(a for a in head_axes if a in present) or None
 
-    def per_device(q, k, v, causal, bias):
+    def per_device(q, k, v, causal, bias, segs):
         if causal and q.shape[1] != k.shape[1]:
             # Causal cross-attention: the dense ring handles the
             # bottom-right offset the flash path does not.
             return ring_attention(
-                q, k, v, axis_name=seq_axis, causal=causal, bias=bias
+                q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
+                segment_ids=segs,
             )
         return ring_flash_attention(
             q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
-            block_q=block_q, block_k=block_k,
+            segment_ids=segs, block_q=block_q, block_k=block_k,
         )
 
     return wrap_seq_parallel_attn(
@@ -342,5 +374,7 @@ def make_ring_flash_attention(
         # [H, S_q, S_k] bias: heads over tp, query rows over sp, full key
         # extent resident (ring steps slice the key-block columns).
         bias_spec=P(h, seq_axis, None),
+        # (q_seg, kv_seg): query ids row-sharded, key ids fully resident.
+        seg_specs=(P(b, seq_axis), P(b, None)),
         per_device=per_device,
     )

@@ -44,12 +44,16 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     bias: Optional[jax.Array] = None,
+    segment_ids=None,  # (q_seg [B, S], kv_seg [B, T]): full extents
     inner_attn=None,
 ):
     """Seq-sharded -> head-sharded -> full local attention -> back.
 
     Call inside ``shard_map``. ``inner_attn`` is any ``AttnFn``; default is
     the plain XLA attention (callers on TPU pass the flash kernel).
+    ``segment_ids`` arrive at full sequence extent (the inner attention
+    sees the whole sequence after the all-to-all) and pass straight
+    through to it.
     """
     if inner_attn is None:
         from ..models.layers import default_attention
@@ -79,12 +83,14 @@ def ulysses_attention(
     # bias arrives pre-sharded head-wise ([H/n, S, T] local — the same
     # contiguous head chunk this device owns after the all-to-all), so it
     # feeds the full-sequence inner attention with no resharding.  Only
-    # pass it through when present: bias-less inner_attn callables (the
-    # original AttnFn protocol) remain valid.
-    if bias is None:
-        out = inner_attn(qg, kg, vg, causal=causal)
-    else:
-        out = inner_attn(qg, kg, vg, causal=causal, bias=bias)
+    # pass operands through when present: bias-less / seg-less inner_attn
+    # callables (the original AttnFn protocol) remain valid.
+    kwargs = {}
+    if bias is not None:
+        kwargs["bias"] = bias
+    if segment_ids is not None:
+        kwargs["segment_ids"] = segment_ids
+    out = inner_attn(qg, kg, vg, causal=causal, **kwargs)
     # [B, S, H/n, D] -> [B, s, H, D]: split sequence, gather heads.
     return all_to_all(out, axis_name, split_dim=1, concat_dim=2)
 
@@ -125,9 +131,12 @@ def make_ulysses_attention(
         # [H, S_q, S_k] bias: heads over sp (the post-all-to-all layout),
         # full sequence extents resident per head slice.
         bias_spec=P(seq_axis, None, None),
-        per_device=lambda q, k, v, causal, bias: ulysses_attention(
+        # segment ids replicate over sp: the inner attention runs the
+        # full sequence per device after the all-to-all.
+        seg_specs=(P(b, None), P(b, None)),
+        per_device=lambda q, k, v, causal, bias, segs: ulysses_attention(
             q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
-            inner_attn=inner_attn,
+            segment_ids=segs, inner_attn=inner_attn,
         ),
         validate=validate,
     )
