@@ -22,7 +22,7 @@ class GPT2Model(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(self, tokens: jax.Array, segment_ids=None) -> jax.Array:
         cfg = self.cfg
         B, S = tokens.shape
         embed = nn.Embed(
@@ -42,7 +42,9 @@ class GPT2Model(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, None), None)
+        (x, _, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")(
+            (x, None, segment_ids), None
+        )
 
         x = make_norm(cfg, name="final_norm")(x)
         if cfg.tie_embeddings:

@@ -126,7 +126,8 @@ class Attention(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, x, *, angles=None, bias=None, causal=True):
+    def __call__(self, x, *, angles=None, bias=None, causal=True,
+                 segment_ids=None):
         cfg = self.cfg
         D = cfg.head_size
         dense = lambda feats, name: nn.DenseGeneral(
@@ -139,7 +140,14 @@ class Attention(nn.Module):
         if angles is not None:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)
-        out = self.attn_fn(q, k, v, causal=causal, bias=bias)
+        # Pass optional operands only when present: seg-less/bias-less
+        # custom AttnFn callables (the original protocol) remain valid.
+        kwargs = {}
+        if bias is not None:
+            kwargs["bias"] = bias
+        if segment_ids is not None:
+            kwargs["segment_ids"] = segment_ids
+        out = self.attn_fn(q, k, v, causal=causal, **kwargs)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, name="wo",
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -261,11 +269,13 @@ class Block(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, x, *, angles=None, bias=None, causal=True):
+    def __call__(self, x, *, angles=None, bias=None, causal=True,
+                 segment_ids=None):
         cfg = self.cfg
         h = make_norm(cfg)(x)
         x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
-            h, angles=angles, bias=bias, causal=causal
+            h, angles=angles, bias=bias, causal=causal,
+            segment_ids=segment_ids,
         )
         h = make_norm(cfg)(x)
         if cfg.moe is not None:

@@ -21,9 +21,9 @@ from .layers import AttnFn, Block, default_attention, make_norm, rope_frequencie
 
 class _BlockWithCarry(nn.Module):
     """Adapter giving Block the carry signature nn.scan expects; applies
-    rematerialization per the config.  Carry is ``(x, angles)`` with
-    ``angles=None`` for non-rope families; encoder families (ViT) set
-    ``causal=False``."""
+    rematerialization per the config.  Carry is ``(x, angles, segs)``
+    with ``angles=None`` for non-rope families and ``segs=None`` for
+    unpacked batches; encoder families (ViT) set ``causal=False``."""
 
     cfg: TransformerConfig
     attn_fn: AttnFn
@@ -31,14 +31,14 @@ class _BlockWithCarry(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, angles = carry
+        x, angles, segs = carry
         block_cls = Block
         if self.cfg.remat == "full":
             block_cls = nn.remat(Block, prevent_cse=False, static_argnums=())
         x = block_cls(self.cfg, attn_fn=self.attn_fn, name="block")(
-            x, angles=angles, causal=self.causal
+            x, angles=angles, causal=self.causal, segment_ids=segs
         )
-        return (x, angles), None
+        return (x, angles, segs), None
 
 
 class LlamaModel(nn.Module):
@@ -46,8 +46,11 @@ class LlamaModel(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        """tokens [B, S] int32 → logits [B, S, vocab] in f32."""
+    def __call__(self, tokens: jax.Array, segment_ids=None) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, vocab] in f32.
+
+        ``segment_ids`` [B, S] (optional) mask cross-document attention
+        for packed-sequence training."""
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size,
@@ -67,7 +70,9 @@ class LlamaModel(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")((x, angles), None)
+        (x, _, _), _ = ScanBlocks(cfg, self.attn_fn, name="blocks")(
+            (x, angles, segment_ids), None
+        )
 
         x = make_norm(cfg, name="final_norm")(x)
         if cfg.tie_embeddings:

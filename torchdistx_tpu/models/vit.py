@@ -90,8 +90,8 @@ class ViTModel(nn.Module):
             length=enc.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), _ = ScanBlocks(enc, self.attn_fn, causal=False, name="blocks")(
-            (x, None), None
+        (x, _, _), _ = ScanBlocks(enc, self.attn_fn, causal=False, name="blocks")(
+            (x, None, None), None
         )
 
         x = make_norm(enc, name="final_norm")(x)

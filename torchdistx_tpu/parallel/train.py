@@ -23,12 +23,27 @@ from ..models.layers import default_attention
 from .pipeline import pipelined_decoder_apply
 
 
-def lm_cross_entropy(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Next-token CE over [B, S, V] logits and [B, S] tokens (shifted)."""
+def lm_cross_entropy(
+    logits: jax.Array, tokens: jax.Array, segment_ids=None
+) -> jax.Array:
+    """Next-token CE over [B, S, V] logits and [B, S] tokens (shifted).
+
+    With ``segment_ids`` (packed sequences), positions whose next token
+    belongs to a different document are excluded — predicting across a
+    packing boundary is noise, not signal.  Padding convention: mark the
+    padded tail with a NEGATIVE segment id; those targets are excluded
+    too (pad tokens attend only each other, which is harmless, and
+    contribute zero loss)."""
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
     tgt = tokens[:, 1:]
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if segment_ids is None:
+        return -jnp.mean(ll)
+    valid = jnp.logical_and(
+        segment_ids[:, :-1] == segment_ids[:, 1:],
+        segment_ids[:, 1:] >= 0,
+    ).astype(jnp.float32)
+    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 def _sum_aux(tree) -> jax.Array:
@@ -80,8 +95,14 @@ def make_train_step(
         else None
     )
 
-    def forward(params, tokens):
+    def forward(params, tokens, segment_ids=None):
         if pipeline:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segment_ids are not threaded through the GPipe "
+                    "microbatch schedule yet; train packed batches on a "
+                    "non-pipeline mesh (dp/fsdp/sp/tp)."
+                )
             logits = pipelined_decoder_apply(
                 cfg, params, tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
@@ -89,20 +110,21 @@ def make_train_step(
                 positions=cfg.positions,
             )
             return logits, jnp.float32(0.0)
+        args = (tokens,) if segment_ids is None else (tokens, segment_ids)
         if cfg.moe is not None:
-            logits, aux_vars = model.apply(params, tokens, mutable=["losses"])
+            logits, aux_vars = model.apply(params, *args, mutable=["losses"])
             return logits, _sum_aux(aux_vars.get("losses", {}))
-        return model.apply(params, tokens), jnp.float32(0.0)
+        return model.apply(params, *args), jnp.float32(0.0)
 
-    def loss_fn(params, tokens):
-        logits, aux = forward(params, tokens)
-        ce = lm_cross_entropy(logits, tokens)
+    def loss_fn(params, tokens, segment_ids=None):
+        logits, aux = forward(params, tokens, segment_ids)
+        ce = lm_cross_entropy(logits, tokens, segment_ids)
         return ce + aux, (ce, aux)
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def train_step(state, tokens):
+    def train_step(state, tokens, segment_ids=None):
         (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], tokens
+            state["params"], tokens, segment_ids
         )
         updates, opt_state = opt.update(grads, state["opt"], state["params"])
         params = optax.apply_updates(state["params"], updates)
