@@ -40,19 +40,26 @@ def pipeline_forward(
     stage_fn: Callable,
     stage_params,
     x_mb: jax.Array,  # [n_mb, mb, S, d]
+    seg_mb: Optional[jax.Array] = None,  # [n_mb, mb, S] packed ids
     *,
     axis_name: str = "pp",
 ) -> jax.Array:
     """Run the GPipe schedule; call inside ``shard_map`` over ``axis_name``.
 
-    ``stage_fn(stage_params, x) -> y`` runs this stage's layers.  Returns
-    the final activations for all microbatches (valid on every stage after
-    the closing psum-broadcast).
+    ``stage_fn(stage_params, x, segs) -> y`` runs this stage's layers.
+    ``seg_mb`` (packed-sequence ids) is replicated on every stage, so
+    the ids for the microbatch stage ``s`` processes at step ``t`` are
+    just ``seg_mb[t - s]`` — indexed locally, no rotation needed
+    (warmup/drain steps read clipped garbage that the validity mask
+    discards, exactly like the activations).  Returns the final
+    activations for all microbatches (valid on every stage after the
+    closing psum-broadcast).
     """
     n = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     n_mb = x_mb.shape[0]
     total = n_mb + n - 1
+    has_segs = seg_mb is not None
 
     buf = jnp.zeros_like(x_mb[0])
     outs = jnp.zeros_like(x_mb)
@@ -61,7 +68,10 @@ def pipeline_forward(
         buf, outs = carry
         feed_idx = jnp.clip(t, 0, n_mb - 1)
         inp = jnp.where(stage == 0, x_mb[feed_idx], buf)
-        y = stage_fn(stage_params, inp)
+        seg_in = (
+            seg_mb[jnp.clip(t - stage, 0, n_mb - 1)] if has_segs else None
+        )
+        y = stage_fn(stage_params, inp, seg_in)
         mb_idx = t - (n - 1)
         valid = (stage == n - 1) & (mb_idx >= 0) & (mb_idx < n_mb)
         widx = jnp.clip(mb_idx, 0, n_mb - 1)
@@ -77,10 +87,11 @@ def pipeline_forward(
 def _block_chain(cfg: TransformerConfig, attn_fn, angles, causal=True):
     block = Block(cfg, attn_fn=attn_fn)
 
-    def chain(stacked_params, x):
+    def chain(stacked_params, x, segs=None):
         def body(carry, layer_params):
             y = block.apply(
-                {"params": layer_params}, carry, angles=angles, causal=causal
+                {"params": layer_params}, carry, angles=angles, causal=causal,
+                segment_ids=segs,
             )
             return y, None
 
@@ -101,6 +112,7 @@ def pipelined_decoder_apply(
     axis_name: str = "pp",
     attn_fn=default_attention,
     positions: Optional[str] = None,  # None = follow cfg.positions
+    segment_ids: Optional[jax.Array] = None,  # [B, S] packed ids
 ):
     """Full decoder-LM forward with pipelined blocks.
 
@@ -139,16 +151,20 @@ def pipelined_decoder_apply(
     chain = _block_chain(cfg, attn_fn, decomp.angles(S), causal=decomp.causal)
 
     x_mb = x.reshape(n_microbatches, B // n_microbatches, S, cfg.d_model)
+    seg_mb = (
+        None if segment_ids is None
+        else segment_ids.reshape(n_microbatches, B // n_microbatches, S)
+    )
 
     pp_fn = shard_map(
         partial(pipeline_forward, chain, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
+        in_specs=(P(axis_name), P(), P()),
         out_specs=P(),
         axis_names={axis_name},
         check_vma=False,
     )
-    y = pp_fn(decomp.block_params(p), x_mb)
+    y = pp_fn(decomp.block_params(p), x_mb, seg_mb)
     x = y.reshape(B, S, cfg.d_model)
 
     # final norm + head (replicated compute)

@@ -97,17 +97,11 @@ def make_train_step(
 
     def forward(params, tokens, segment_ids=None):
         if pipeline:
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "packed segment_ids are not threaded through the GPipe "
-                    "microbatch schedule yet; train packed batches on a "
-                    "non-pipeline mesh (dp/fsdp/sp/tp)."
-                )
             logits = pipelined_decoder_apply(
                 cfg, params, tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
                 attn_fn=attn_fn or default_attention,
-                positions=cfg.positions,
+                positions=cfg.positions, segment_ids=segment_ids,
             )
             return logits, jnp.float32(0.0)
         args = (tokens,) if segment_ids is None else (tokens, segment_ids)
