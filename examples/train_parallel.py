@@ -30,3 +30,15 @@ state = init_state(params)
 for i in range(5):
     state, metrics = step(state, shard_batch(toks))
     print(f"step {i}: loss {float(metrics['loss']):.4f}")
+
+# Packed sequences: two documents per row + a padded tail (negative id).
+# Attention masks cross-document pairs in-kernel; the loss skips packing
+# boundaries and padding.
+import jax.numpy as jnp
+
+seg = jnp.concatenate(
+    [jnp.zeros((8, 12), jnp.int32), jnp.ones((8, 12), jnp.int32),
+     jnp.full((8, 8), -1, jnp.int32)], axis=1,
+)
+state, metrics = step(state, shard_batch(toks), shard_batch(seg))
+print(f"packed step: loss {float(metrics['loss']):.4f}")

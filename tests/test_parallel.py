@@ -283,8 +283,6 @@ class TestPipeline:
 
         # The backward through the seg-aware schedule (the path the old
         # NotImplementedError in make_train_step used to block).
-        from torchdistx_tpu.parallel.train import make_train_step
-
         init_state, step, shard_batch = make_train_step(
             m, cfg, mesh, pipeline=True, n_microbatches=4
         )

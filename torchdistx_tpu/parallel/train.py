@@ -89,6 +89,17 @@ def make_train_step(
         )
     batch_sharding = NamedSharding(mesh, P(baxes if baxes else None, None))
 
+    if pipeline and cfg.moe is not None:
+        # The pipelined forward runs blocks via lax.scan over raw param
+        # stacks and cannot collect flax's mutable "losses" collection,
+        # so the MoE router load-balancing aux loss is NOT applied.
+        warnings.warn(
+            "make_train_step(pipeline=True) with an MoE config: the router "
+            "load-balancing aux loss is not collected through the pipeline "
+            "schedule (metrics report aux=0.0). Experts may imbalance; "
+            "prefer ep/fsdp meshes for MoE training."
+        )
+
     decomp = (
         model.pipeline_decomposition()
         if pipeline and hasattr(model, "pipeline_decomposition")
