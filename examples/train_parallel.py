@@ -10,6 +10,7 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 import jax
+import jax.numpy as jnp
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -34,8 +35,6 @@ for i in range(5):
 # Packed sequences: two documents per row + a padded tail (negative id).
 # Attention masks cross-document pairs in-kernel; the loss skips packing
 # boundaries and padding.
-import jax.numpy as jnp
-
 seg = jnp.concatenate(
     [jnp.zeros((8, 12), jnp.int32), jnp.ones((8, 12), jnp.int32),
      jnp.full((8, 8), -1, jnp.int32)], axis=1,

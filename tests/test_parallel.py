@@ -591,7 +591,7 @@ class TestRingFlash:
         # scan-stacked blocks -> ring-flash kernels, and the train step
         # masks next-token CE at packing boundaries.
         from torchdistx_tpu.parallel import make_ring_flash_attention
-        from torchdistx_tpu.parallel.train import lm_cross_entropy, make_train_step
+        from torchdistx_tpu.parallel.train import lm_cross_entropy
 
         cfg = TINY
         model = make_llama(cfg, attn_fn=make_ring_flash_attention(mesh))
