@@ -5,6 +5,13 @@ enough, ops here drop to hand-written pallas TPU kernels. Every kernel has
 an interpret-mode path so the full test suite runs on CPU.
 """
 
+from .autotune import tune_flash_blocks
 from .flash_attention import flash_attention, make_flash_attention
+from .segments import normalize_segment_ids
 
-__all__ = ["flash_attention", "make_flash_attention"]
+__all__ = [
+    "flash_attention",
+    "make_flash_attention",
+    "normalize_segment_ids",
+    "tune_flash_blocks",
+]

@@ -1,0 +1,154 @@
+"""Flash-attention block-size autotuner.
+
+The kernels' perf on a given chip hinges on (block_q, block_k): round 2's
+hand search found 1024x1024 ~2x faster than the 512x512 first guess on a
+v5e at S=2048 (README bench table).  This module turns that search into a
+cached utility: measure each candidate on the live device with the same
+data-dependent chain scheme the bench uses (dispatch latency cancels),
+pick the fastest, and remember the answer per (device kind, shape,
+dtype, causality) in a small JSON cache so repeated runs pay nothing.
+
+Usage::
+
+    from torchdistx_tpu.ops import make_flash_attention, tune_flash_blocks
+    bq, bk = tune_flash_blocks(batch=4, seq_len=2048, heads=16, head_dim=64)
+    attn = make_flash_attention(block_q=bq, block_k=bk)
+
+Off-TPU the kernels run in interpreter mode where block sizes carry no
+hardware meaning; the tuner still works (useful for tests) but its
+numbers only matter on a real chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Candidates honor Mosaic's tiling rules for every operand this kernel
+# family streams (minor dims 128-divisible; see flash_attention.py).
+DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
+    (512, 512), (512, 1024), (1024, 512), (1024, 1024), (2048, 1024),
+)
+
+
+def _cache_path() -> str:
+    from .. import config
+
+    base = config.get().cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "torchdistx_tpu"
+    )
+    return os.path.join(base, "flash_blocks.json")
+
+
+def _cache_key(device_kind: str, shape, dtype, causal: bool) -> str:
+    return (
+        f"{device_kind}|{'x'.join(map(str, shape))}|"
+        f"{jnp.dtype(dtype).name}|causal={causal}"
+    )
+
+
+def _read_cache(key: str):
+    try:
+        with open(_cache_path()) as f:
+            entry = json.load(f).get(key)
+        return tuple(entry) if entry else None
+    except (OSError, ValueError):
+        return None
+
+
+def _write_cache(key: str, blocks: Tuple[int, int]) -> None:
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[key] = list(blocks)
+        with open(path, "w") as f:
+            json.dump(data, f)
+    except OSError:
+        pass  # tuning still returns the measured answer
+
+
+def _measure(fn, q, k, v, n_lo=2, n_hi=10) -> float:
+    """Per-iteration seconds via the chain scheme (see bench.py): N
+    data-dependent steps inside one jit, difference two N values."""
+
+    @jax.jit
+    def g(q, n):
+        out = lax.fori_loop(0, n, lambda i, x: fn(x, k, v).astype(x.dtype), q)
+        return out.sum()
+
+    lo = jnp.asarray(n_lo, jnp.int32)
+    hi = jnp.asarray(n_hi, jnp.int32)
+    float(g(q, lo))  # compile + warm
+    float(g(q, hi))
+    t0 = time.perf_counter()
+    float(g(q, lo))
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(g(q, hi))
+    t_hi = time.perf_counter() - t0
+    return (t_hi - t_lo) / (n_hi - n_lo)
+
+
+def tune_flash_blocks(
+    *,
+    batch: int = 4,
+    seq_len: int = 2048,
+    heads: int = 16,
+    head_dim: int = 64,
+    kv_heads: Optional[int] = None,
+    causal: bool = True,
+    dtype=jnp.bfloat16,
+    candidates: Sequence[Tuple[int, int]] = DEFAULT_CANDIDATES,
+    use_cache: bool = True,
+    interpret: Optional[bool] = None,
+) -> Tuple[int, int]:
+    """Measure ``candidates`` on the live device and return the fastest
+    ``(block_q, block_k)``, cached per (device kind, shape, dtype,
+    causality)."""
+    from .flash_attention import flash_attention
+
+    kv = kv_heads or heads
+    shape = (batch, seq_len, heads, kv, head_dim)
+    device_kind = jax.devices()[0].device_kind
+    key = _cache_key(device_kind, shape, dtype, causal)
+    if use_cache:
+        cached = _read_cache(key)
+        if cached is not None:
+            return cached
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (batch, seq_len, heads, head_dim), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (batch, seq_len, kv, head_dim), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (batch, seq_len, kv, head_dim), dtype)
+
+    best, best_t = None, float("inf")
+    for bq, bk in candidates:
+        if bq > seq_len or bk > seq_len:
+            continue
+
+        def fn(q, k, v, bq=bq, bk=bk):
+            return flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk,
+                interpret=interpret,
+            )
+
+        t = _measure(fn, q, k, v)
+        if t < best_t:
+            best, best_t = (bq, bk), t
+    if best is None:
+        raise ValueError(
+            f"no candidate fits seq_len={seq_len}: {tuple(candidates)}"
+        )
+    if use_cache:
+        _write_cache(key, best)
+    return best
