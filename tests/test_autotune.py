@@ -20,7 +20,7 @@ def cache_dir(tmp_path):
         yield tmp_path
 
 
-def test_returns_candidate_and_caches(cache_dir):
+def test_returns_candidate_and_caches(cache_dir, monkeypatch):
     cands = ((16, 16), (32, 16))
     blocks = tune_flash_blocks(
         batch=1, seq_len=32, heads=2, head_dim=16, candidates=cands,
@@ -31,18 +31,44 @@ def test_returns_candidate_and_caches(cache_dir):
     data = json.load(open(path))
     key = next(iter(data))
     assert jax.devices()[0].device_kind in key
-    assert "float32" in key or "bfloat16" in key  # dtype is part of the key
-    # Second call hits the cache: poison the candidate list to prove the
-    # measurement loop never runs.
+    assert "bfloat16" in key  # dtype is part of the key
+    assert "interpret=" in key  # interpreter winners never serve real chips
+    # Second call hits the cache: measuring again would be a bug.
+    monkeypatch.setattr(
+        autotune, "_measure",
+        lambda *a, **k: pytest.fail("re-measured despite a valid cache hit"),
+    )
     again = tune_flash_blocks(
-        batch=1, seq_len=32, heads=2, head_dim=16, candidates=(),
+        batch=1, seq_len=32, heads=2, head_dim=16, candidates=cands,
     )
     assert again == blocks
 
 
-def test_no_fitting_candidate_raises(cache_dir):
-    with pytest.raises(ValueError, match="no candidate fits"):
+def test_cached_winner_outside_candidates_remeasures(cache_dir):
+    # A cached winner must not be served to a call whose candidate set
+    # excludes it (e.g. a memory-constrained caller).
+    tune_flash_blocks(
+        batch=1, seq_len=32, heads=2, head_dim=16, candidates=((32, 32),),
+    )
+    blocks = tune_flash_blocks(
+        batch=1, seq_len=32, heads=2, head_dim=16, candidates=((16, 16),),
+    )
+    assert blocks == (16, 16)
+
+
+def test_oversized_candidates_clamp(cache_dir):
+    # seq_len below every candidate: clamp like flash_attention does
+    # instead of refusing to tune short contexts.
+    blocks = tune_flash_blocks(
+        batch=1, seq_len=8, heads=2, head_dim=16,
+        candidates=((64, 64), (128, 64)), use_cache=False,
+    )
+    assert blocks == (8, 8)
+
+
+def test_empty_candidates_raise(cache_dir):
+    with pytest.raises(ValueError, match="candidate list is empty"):
         tune_flash_blocks(
             batch=1, seq_len=8, heads=2, head_dim=16,
-            candidates=((64, 64),), use_cache=False,
+            candidates=(), use_cache=False,
         )

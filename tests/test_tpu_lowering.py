@@ -49,6 +49,20 @@ def test_flash_fwd_bwd_lowers_for_tpu(kv_heads):
     assert _export(fwd_and_grads, q, k, v).mlir_module()
 
 
+def test_bench_shape_lowers_for_tpu():
+    # The production bench configuration (B=4, H=16, S=2048, D=64,
+    # blocks 1024x1024, bf16, causal) — exactly what phase_flash compiles
+    # on the chip.
+    q = jnp.zeros((4, 2048, 16, 64), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, block_q=1024, block_k=1024, interpret=False
+        )
+
+    assert _export(fwd, q, q, q).mlir_module()
+
+
 @pytest.mark.parametrize("bias_heads", [H, 1])
 def test_flash_bias_and_segments_lower_for_tpu(bias_heads):
     # The full operand surface in one program: additive bias (incl. the

@@ -46,10 +46,13 @@ def _cache_path() -> str:
     return os.path.join(base, "flash_blocks.json")
 
 
-def _cache_key(device_kind: str, shape, dtype, causal: bool) -> str:
+def _cache_key(device_kind: str, shape, dtype, causal: bool,
+               interpret: bool) -> str:
+    # interpret is part of the key: interpreter-mode "winners" are
+    # hardware-meaningless and must never be served to a real-chip call.
     return (
         f"{device_kind}|{'x'.join(map(str, shape))}|"
-        f"{jnp.dtype(dtype).name}|causal={causal}"
+        f"{jnp.dtype(dtype).name}|causal={causal}|interpret={interpret}"
     )
 
 
@@ -72,15 +75,25 @@ def _write_cache(key: str, blocks: Tuple[int, int]) -> None:
         except (OSError, ValueError):
             data = {}
         data[key] = list(blocks)
-        with open(path, "w") as f:
+        # Atomic replace: concurrent tuners (multi-host pod startup) can
+        # still lose each other's read-modify-write, but no reader ever
+        # sees a torn file — at worst a key re-measures next launch.
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(data, f)
+        os.replace(tmp, path)
     except OSError:
         pass  # tuning still returns the measured answer
 
 
-def _measure(fn, q, k, v, n_lo=2, n_hi=10) -> float:
+def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
     """Per-iteration seconds via the chain scheme (see bench.py): N
-    data-dependent steps inside one jit, difference two N values."""
+    data-dependent steps inside one jit, difference two N values.
+
+    The lo/hi pair is repeated and the smallest positive delta wins —
+    one host-side hiccup (GC pause, tunnel latency spike) must not pin a
+    wrong block size into the persistent cache.  All-nonpositive deltas
+    are pure noise: report +inf so the candidate cannot win on junk."""
 
     @jax.jit
     def g(q, n):
@@ -91,13 +104,17 @@ def _measure(fn, q, k, v, n_lo=2, n_hi=10) -> float:
     hi = jnp.asarray(n_hi, jnp.int32)
     float(g(q, lo))  # compile + warm
     float(g(q, hi))
-    t0 = time.perf_counter()
-    float(g(q, lo))
-    t_lo = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(g(q, hi))
-    t_hi = time.perf_counter() - t0
-    return (t_hi - t_lo) / (n_hi - n_lo)
+    deltas = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(g(q, lo))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(g(q, hi))
+        t_hi = time.perf_counter() - t0
+        deltas.append((t_hi - t_lo) / (n_hi - n_lo))
+    pos = [d for d in deltas if d > 0]
+    return min(pos) if pos else float("inf")
 
 
 def tune_flash_blocks(
@@ -115,26 +132,40 @@ def tune_flash_blocks(
 ) -> Tuple[int, int]:
     """Measure ``candidates`` on the live device and return the fastest
     ``(block_q, block_k)``, cached per (device kind, shape, dtype,
-    causality)."""
-    from .flash_attention import flash_attention
+    causality, interpret).
+
+    Oversized candidates are clamped to the (8-rounded) sequence length,
+    mirroring :func:`flash_attention`'s own clamping, then deduplicated —
+    every ``seq_len`` is tunable with the default candidate list.  A
+    cached winner is only served when it belongs to the requested
+    candidate set (after clamping); otherwise the requested set is
+    re-measured."""
+    from .flash_attention import _round8, flash_attention
 
     kv = kv_heads or heads
     shape = (batch, seq_len, heads, kv, head_dim)
     device_kind = jax.devices()[0].device_kind
-    key = _cache_key(device_kind, shape, dtype, causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = _cache_key(device_kind, shape, dtype, causal, interpret)
+
+    cap = _round8(seq_len)
+    clamped = tuple(dict.fromkeys(
+        (min(bq, cap), min(bk, cap)) for bq, bk in candidates
+    ))
     if use_cache:
         cached = _read_cache(key)
-        if cached is not None:
+        if cached is not None and (not clamped or cached in clamped):
             return cached
+    if not clamped:
+        raise ValueError("no candidate fits: the candidate list is empty")
 
     q = jax.random.normal(jax.random.PRNGKey(0), (batch, seq_len, heads, head_dim), dtype)
     k = jax.random.normal(jax.random.PRNGKey(1), (batch, seq_len, kv, head_dim), dtype)
     v = jax.random.normal(jax.random.PRNGKey(2), (batch, seq_len, kv, head_dim), dtype)
 
     best, best_t = None, float("inf")
-    for bq, bk in candidates:
-        if bq > seq_len or bk > seq_len:
-            continue
+    for bq, bk in clamped:
 
         def fn(q, k, v, bq=bq, bk=bk):
             return flash_attention(
@@ -145,10 +176,8 @@ def tune_flash_blocks(
         t = _measure(fn, q, k, v)
         if t < best_t:
             best, best_t = (bq, bk), t
-    if best is None:
-        raise ValueError(
-            f"no candidate fits seq_len={seq_len}: {tuple(candidates)}"
-        )
+    if best is None:  # every candidate measured as pure noise: pick any
+        best = clamped[0]
     if use_cache:
         _write_cache(key, best)
     return best
