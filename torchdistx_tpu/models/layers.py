@@ -121,6 +121,17 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return out.astype(dtype)
 
 
+def _optional_attn_kwargs(bias, segment_ids) -> dict:
+    """Pass optional operands only when present: seg-less/bias-less
+    custom AttnFn callables (the original protocol) remain valid."""
+    kwargs = {}
+    if bias is not None:
+        kwargs["bias"] = bias
+    if segment_ids is not None:
+        kwargs["segment_ids"] = segment_ids
+    return kwargs
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     attn_fn: AttnFn = default_attention
@@ -140,14 +151,10 @@ class Attention(nn.Module):
         if angles is not None:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)
-        # Pass optional operands only when present: seg-less/bias-less
-        # custom AttnFn callables (the original protocol) remain valid.
-        kwargs = {}
-        if bias is not None:
-            kwargs["bias"] = bias
-        if segment_ids is not None:
-            kwargs["segment_ids"] = segment_ids
-        out = self.attn_fn(q, k, v, causal=causal, **kwargs)
+        out = self.attn_fn(
+            q, k, v, causal=causal,
+            **_optional_attn_kwargs(bias, segment_ids),
+        )
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, name="wo",
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -159,7 +166,10 @@ class CrossAttention(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, x, kv, *, bias=None):
+    def __call__(self, x, kv, *, bias=None, segment_ids=None):
+        # segment_ids: ([B, S_q], [B, S_kv]) pair for packed enc-dec
+        # batches (each decoder position attends only its own document's
+        # encoder positions).
         cfg = self.cfg
         D = cfg.head_size
         dense = lambda feats, name: nn.DenseGeneral(
@@ -169,7 +179,10 @@ class CrossAttention(nn.Module):
         q = dense((cfg.n_heads, D), "wq")(x)
         k = dense((cfg.kv_heads, D), "wk")(kv)
         v = dense((cfg.kv_heads, D), "wv")(kv)
-        out = self.attn_fn(q, k, v, causal=False, bias=bias)
+        out = self.attn_fn(
+            q, k, v, causal=False,
+            **_optional_attn_kwargs(bias, segment_ids),
+        )
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, name="wo",
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,

@@ -76,15 +76,15 @@ class _EncBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, bias = carry
+        x, bias, segs = carry
         cfg = self.cfg
         h = make_norm(cfg)(x)
         x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
-            h, bias=bias, causal=False
+            h, bias=bias, causal=False, segment_ids=segs
         )
         h = make_norm(cfg)(x)
         x = x + MLP(cfg, name="mlp")(h)
-        return (x, bias), None
+        return (x, bias, segs), None
 
 
 class _DecBlock(nn.Module):
@@ -93,17 +93,22 @@ class _DecBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, enc, bias = carry
+        x, enc, bias, dec_segs, enc_segs = carry
         cfg = self.cfg
         h = make_norm(cfg)(x)
         x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
-            h, bias=bias, causal=True
+            h, bias=bias, causal=True, segment_ids=dec_segs
         )
         h = make_norm(cfg)(x)
-        x = x + CrossAttention(cfg, attn_fn=self.attn_fn, name="cross")(h, enc)
+        cross_segs = (
+            (dec_segs, enc_segs) if dec_segs is not None else None
+        )
+        x = x + CrossAttention(cfg, attn_fn=self.attn_fn, name="cross")(
+            h, enc, segment_ids=cross_segs
+        )
         h = make_norm(cfg)(x)
         x = x + MLP(cfg, name="mlp")(h)
-        return (x, enc, bias), None
+        return (x, enc, bias, dec_segs, enc_segs), None
 
 
 def _scan(block_cls, cfg, attn_fn, name):
@@ -121,8 +126,17 @@ class T5Model(nn.Module):
     attn_fn: AttnFn = default_attention
 
     @nn.compact
-    def __call__(self, enc_tokens: jax.Array, dec_tokens: jax.Array) -> jax.Array:
+    def __call__(self, enc_tokens: jax.Array, dec_tokens: jax.Array,
+                 segment_ids=None) -> jax.Array:
+        """``segment_ids`` (optional) is an ``(enc_seg [B, S_enc],
+        dec_seg [B, S_dec])`` pair for packed enc-dec batches: encoder
+        self-attention masks by enc ids, decoder self-attention by dec
+        ids, and cross-attention pairs each decoder position with its
+        own document's encoder span."""
         cfg = self.cfg
+        enc_segs = dec_segs = None
+        if segment_ids is not None:
+            enc_segs, dec_segs = segment_ids
         embed = nn.Embed(
             cfg.vocab_size, cfg.encoder.d_model,
             dtype=cfg.encoder.dtype, param_dtype=cfg.encoder.param_dtype,
@@ -134,7 +148,9 @@ class T5Model(nn.Module):
         ebias = RelativePositionBias(cfg.encoder, bidirectional=True, name="enc_relpos")(
             enc_tokens.shape[1], enc_tokens.shape[1]
         )
-        (e, _), _ = _scan(_EncBlock, cfg.encoder, self.attn_fn, "enc_blocks")((e, ebias), None)
+        (e, _, _), _ = _scan(_EncBlock, cfg.encoder, self.attn_fn, "enc_blocks")(
+            (e, ebias, enc_segs), None
+        )
         e = make_norm(cfg.encoder)(e)
 
         # Decoder
@@ -142,8 +158,8 @@ class T5Model(nn.Module):
         dbias = RelativePositionBias(cfg.decoder, bidirectional=False, name="dec_relpos")(
             dec_tokens.shape[1], dec_tokens.shape[1]
         )
-        (d, _, _), _ = _scan(_DecBlock, cfg.decoder, self.attn_fn, "dec_blocks")(
-            (d, e, dbias), None
+        (d, _, _, _, _), _ = _scan(_DecBlock, cfg.decoder, self.attn_fn, "dec_blocks")(
+            (d, e, dbias, dec_segs, enc_segs), None
         )
         d = make_norm(cfg.decoder)(d)
 
