@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test wheel clean
+.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -46,6 +46,12 @@ test:
 # setup.py install_cmake wheel flow; setup.py itself runs `make native`).
 wheel:
 	python -m pip wheel --no-deps --no-build-isolation -w dist .
+
+# Run the conda packaging pipeline's build + native install scripts into
+# scratch prefixes and assert the package file partition (no conda-build
+# needed; see packaging/conda/smoke.sh).
+packaging-smoke:
+	bash packaging/conda/smoke.sh
 
 clean:
 	rm -rf csrc/build torchdistx_tpu/_lib

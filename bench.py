@@ -452,7 +452,10 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
     phases only), a failed run — the axon tunnel wedges for hours at a
     time — reports the last successful measurement instead, honestly
     labeled with its age via ``stale_s``.  The headline phases never use
-    the cache: the scoreboard number is always freshly measured."""
+    this per-phase fallback; when a fresh headline could only be
+    measured on CPU, main() may PROMOTE the last cached hardware pair to
+    the headline, explicitly labeled (headline_from_cache, ages, and the
+    fresh CPU pair preserved under cpu_fresh_*)."""
     err = None
     try:
         res = subprocess.run(

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Top-level conda build step: compile the native graph engine ONCE; every
+# output's install-*.sh installs from this build tree, so all four
+# packages ship the same binaries (docstring parity: the reference's
+# packaging/conda/build.sh builds once and splits debug symbols for its
+# -cc-debug package; we do the same with objcopy).
+#
+# Runs under conda-build ($SRC_DIR/$PREFIX set) or standalone for the
+# smoke test (set SRC_DIR to the repo root).
+
+set -o errexit -o nounset -o pipefail
+
+BUILD_DIR="${TDX_CONDA_BUILD_DIR:-$SRC_DIR/build-conda}"
+
+cmake -GNinja \
+      -DCMAKE_BUILD_TYPE=RelWithDebInfo \
+      -DCMAKE_INSTALL_LIBDIR=lib \
+      -DTDX_LIB_OUTPUT_DIR="$BUILD_DIR/lib" \
+      -S "$SRC_DIR/csrc" \
+      -B "$BUILD_DIR"
+cmake --build "$BUILD_DIR"
+
+# Split the debug symbols out of the shared library; install-cc-debug.sh
+# packages the .debug files, install-cc.sh the stripped runtime libs.
+# Idempotence guard: on a re-run against an existing build dir where
+# ninja relinked nothing, the lib is already stripped+linked — running
+# --only-keep-debug on it again would overwrite the good .debug file
+# with a symbol-less husk (objcopy exits 0 both times).
+find "$BUILD_DIR" -type f -name "libtdxgraph.so*" ! -name "*.debug" \
+    | while read -r lib; do
+    if readelf -S "$lib" | grep -q ".gnu_debuglink"; then
+        continue
+    fi
+    objcopy --only-keep-debug "$lib" "$lib.debug"
+    objcopy --strip-debug --add-gnu-debuglink="$lib.debug" "$lib"
+done

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# torchdistx-tpu-cc-debug: the debug symbols build.sh split out with
+# objcopy, installed next to where the runtime libs land so gdb's
+# gnu-debuglink lookup finds them.
+
+set -o errexit -o nounset -o pipefail
+
+BUILD_DIR="${TDX_CONDA_BUILD_DIR:-$SRC_DIR/build-conda}"
+
+mkdir -p "$PREFIX/lib"
+find "$BUILD_DIR" -type f -name "libtdxgraph.so*.debug" \
+    -exec install -m 0644 "{}" "$PREFIX/lib/" ";"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# torchdistx-tpu-cc-devel: headers + CMake package config + the dev
+# symlink, for standalone C++ consumers (find_package(tdxgraph)).
+
+set -o errexit -o nounset -o pipefail
+
+BUILD_DIR="${TDX_CONDA_BUILD_DIR:-$SRC_DIR/build-conda}"
+
+cmake --install "$BUILD_DIR" --component cc --prefix "$PREFIX"
+rm -f "$PREFIX"/lib/libtdxgraph.so.*      # versioned libs live in -cc

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# torchdistx-tpu-cc: the native runtime — versioned shared libs only.
+# Headers/cmake config live in -cc-devel and the dev symlink with them,
+# so the outputs partition the installed files with no clobbering.
+
+set -o errexit -o nounset -o pipefail
+
+BUILD_DIR="${TDX_CONDA_BUILD_DIR:-$SRC_DIR/build-conda}"
+
+cmake --install "$BUILD_DIR" --component cc --prefix "$PREFIX"
+rm -rf "$PREFIX/include/tdx_graph.h" "$PREFIX/lib/cmake/tdxgraph"
+rm -f "$PREFIX/lib/libtdxgraph.so"        # dev symlink -> -cc-devel
+rm -f "$PREFIX"/lib/libtdxgraph.so*.debug # debug symbols -> -cc-debug

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# torchdistx-tpu: the Python package.  Bundles its own copy of the
+# engine in torchdistx_tpu/_lib/ (setup.py runs `make native`; ctypes
+# falls back to pure Python where no compiler exists).
+
+set -o errexit -o nounset -o pipefail
+
+cd "$SRC_DIR"
+make native || true
+"$PYTHON" -m pip install . -vv --no-deps --no-build-isolation

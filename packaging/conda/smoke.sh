@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Smoke-run the conda packaging pipeline WITHOUT conda-build: build once,
+# run each native install script into its own scratch prefix, and assert
+# the four-way file partition the recipe promises.  `make packaging-smoke`
+# runs this in the CI image (cmake/ninja/objcopy are all present).
+
+set -o errexit -o nounset -o pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+export SRC_DIR="${SRC_DIR:-$(cd "$HERE/../.." && pwd)}"
+SCRATCH="$(mktemp -d /tmp/tdx_conda_smoke.XXXXXX)"
+export TDX_CONDA_BUILD_DIR="$SCRATCH/build"
+trap 'rm -rf "$SCRATCH"' EXIT
+
+bash "$HERE/build.sh"
+
+fail() { echo "packaging smoke FAILED: $1"; exit 1; }
+
+PREFIX="$SCRATCH/cc"       bash "$HERE/install-cc.sh"
+PREFIX="$SCRATCH/devel"    bash "$HERE/install-cc-devel.sh"
+PREFIX="$SCRATCH/debug"    bash "$HERE/install-cc-debug.sh"
+
+# -cc: versioned runtime libs, nothing else
+ls "$SCRATCH"/cc/lib/libtdxgraph.so.* > /dev/null 2>&1 \
+    || fail "-cc is missing the versioned runtime lib"
+[ ! -e "$SCRATCH/cc/include/tdx_graph.h" ] || fail "-cc leaked the header"
+[ ! -e "$SCRATCH/cc/lib/libtdxgraph.so" ] || fail "-cc leaked the dev symlink"
+find "$SCRATCH/cc" -name "*.debug" | grep -q . \
+    && fail "-cc leaked debug symbols" || true
+
+# -cc-devel: header + cmake config + dev symlink, no versioned libs
+[ -f "$SCRATCH/devel/include/tdx_graph.h" ] || fail "-cc-devel missing header"
+[ -f "$SCRATCH/devel/lib/cmake/tdxgraph/tdxgraph-config.cmake" ] \
+    || fail "-cc-devel missing cmake config"
+[ -L "$SCRATCH/devel/lib/libtdxgraph.so" ] || fail "-cc-devel missing symlink"
+ls "$SCRATCH"/devel/lib/libtdxgraph.so.* > /dev/null 2>&1 \
+    && fail "-cc-devel leaked versioned libs" || true
+
+# -cc-debug: the split symbols, and the runtime lib still links to them
+ls "$SCRATCH"/debug/lib/libtdxgraph.so.*.debug > /dev/null 2>&1 \
+    || fail "-cc-debug is missing the split symbols"
+readelf -p .gnu_debuglink "$SCRATCH"/cc/lib/libtdxgraph.so.* 2>/dev/null \
+    | grep -q "libtdxgraph" || fail "runtime lib lost its gnu-debuglink"
+
+echo "packaging smoke OK: cc / cc-devel / cc-debug partition verified"

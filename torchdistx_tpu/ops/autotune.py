@@ -176,8 +176,13 @@ def tune_flash_blocks(
         t = _measure(fn, q, k, v)
         if t < best_t:
             best, best_t = (bq, bk), t
-    if best is None:  # every candidate measured as pure noise: pick any
-        best = clamped[0]
+    if best is None:
+        # Every candidate measured as pure noise (host hiccups): return
+        # an arbitrary pick for this call, but do NOT cache it — a
+        # transient hiccup must not permanently pin an unmeasured block
+        # size for this (device, shape, dtype) key; the next launch
+        # re-measures.
+        return clamped[0]
     if use_cache:
         _write_cache(key, best)
     return best

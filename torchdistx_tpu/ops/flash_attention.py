@@ -55,6 +55,7 @@ from .segments import normalize_segment_ids
 
 _NEG = -1e30
 _LANES = 128  # TPU lane width: scratch vectors are carried at full lanes
+_SEG_LANES = 8  # segment-id carriers: one int32 sublane tile is enough
 
 
 def _causal_mask(q_start, k_start, block_q, block_k, seq_len_k, offset, causal):
@@ -92,7 +93,7 @@ def _fwd_kernel(
     k_ref,  # [1, block_k, D]
     v_ref,  # [1, block_k, D]
     *rest,  # [bias_ref [1, block_q, block_k] if has_bias,]
-    #         [qseg_ref / kseg_ref [1, block, _LANES] i32 if has_segs,]
+    #         [qseg_ref / kseg_ref [1, block, _SEG_LANES] i32 if has_segs,]
     #         o_ref [1, block_q, D],
     #         lse_ref [1, block_q, _LANES] (lse broadcast across full
     #           lanes, the upstream TPU flash layout — a 1-wide minor dim
@@ -410,26 +411,41 @@ def _bias_spec(Hb, H, block_q, block_k):
 
 def _seg_carrier(seg: jax.Array, block: int) -> jax.Array:
     """[B, S] int32 ids, zero-padded to a block multiple and broadcast to
-    full lane width (the same row-carrier layout as lse/delta; kernels
-    read lane 0).  Padded rows are provably inert: padded q rows carry
-    zero ``do``/``delta`` and padded key columns are masked by
-    ``seq_len_k``, so their contributions vanish regardless of id."""
+    ``_SEG_LANES`` lanes (kernels read lane 0; 8 lanes — one int32
+    sublane tile — is the narrowest minor dim Mosaic tiles, 16x less HBM
+    traffic than a full 128-lane carrier; ADVICE r2).  Padded rows are
+    provably inert: padded q rows carry zero ``do``/``delta`` and padded
+    key columns are masked by ``seq_len_k``, so their contributions
+    vanish regardless of id."""
     segp = _pad_seq(seg.astype(jnp.int32), block)
-    return jnp.broadcast_to(segp[:, :, None], (*segp.shape, _LANES))
+    return jnp.broadcast_to(segp[:, :, None], (*segp.shape, _SEG_LANES))
+
+
+def _seg_carriers(qseg, kseg, block_q, block_k):
+    """Both carriers, built ONCE per _flash_core call and threaded through
+    the fwd/bwd pallas_calls (ADVICE r2: they used to be rebuilt per
+    call)."""
+    if qseg is None:
+        return None
+    return (_seg_carrier(qseg, block_q), _seg_carrier(kseg, block_k))
 
 
 def _seg_specs(heads, block_q, block_k):
     """(q, k) carrier BlockSpecs for the (bh, qi, kj) grids: the batch
     row is bh // heads (ids are per-batch, shared by every head)."""
     return (
-        pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kj: (bh // heads, qi, 0)),
-        pl.BlockSpec((1, block_k, _LANES), lambda bh, qi, kj: (bh // heads, kj, 0)),
+        pl.BlockSpec(
+            (1, block_q, _SEG_LANES), lambda bh, qi, kj: (bh // heads, qi, 0)
+        ),
+        pl.BlockSpec(
+            (1, block_k, _SEG_LANES), lambda bh, qi, kj: (bh // heads, kj, 0)
+        ),
     )
 
 
 def _fwd_call(
     qh, kh, vh, groups, causal, block_q, block_k, interpret,
-    bias=None, heads=None, segs=None,
+    bias=None, heads=None, segc=None,
 ):
     BH, S, D = qh.shape
     T = kh.shape[1]
@@ -447,17 +463,15 @@ def _fwd_call(
     if bias is not None:
         in_specs.append(_bias_spec(bias.shape[0], heads, block_q, block_k))
         operands.append(_pad_bias(bias, block_q, block_k))
-    if segs is not None:
+    if segc is not None:
         in_specs.extend(_seg_specs(heads, block_q, block_k))
-        operands.extend(
-            [_seg_carrier(segs[0], block_q), _seg_carrier(segs[1], block_k)]
-        )
+        operands.extend(segc)
 
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, seq_len_k=T, offset=T - S,
-            has_bias=bias is not None, has_segs=segs is not None,
+            has_bias=bias is not None, has_segs=segc is not None,
         ),
         grid=(BH, nq, nk),
         in_specs=in_specs,
@@ -484,7 +498,7 @@ def _fwd_call(
 
 def _bwd_call(
     qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
-    delta3=None, bias=None, heads=None, segs=None, want_dbias=False,
+    delta3=None, bias=None, heads=None, segc=None, want_dbias=False,
 ):
     BH, S, D = qh.shape
     T = kh.shape[1]
@@ -500,10 +514,6 @@ def _bwd_call(
     nq, nk = qp.shape[1] // block_q, kp.shape[1] // block_k
     biasp = None if bias is None else _pad_bias(bias, block_q, block_k)
     Hb = None if bias is None else bias.shape[0]
-    segc = (
-        None if segs is None
-        else (_seg_carrier(segs[0], block_q), _seg_carrier(segs[1], block_k))
-    )
 
     common = dict(
         causal=causal, sm_scale=sm_scale,
@@ -570,10 +580,11 @@ def _bwd_call(
     if segc is not None:
         dkv_specs.extend([
             pl.BlockSpec(
-                (1, block_q, _LANES), lambda bkv, kj, it: (bkv // KV, it % nq, 0)
+                (1, block_q, _SEG_LANES),
+                lambda bkv, kj, it: (bkv // KV, it % nq, 0),
             ),
             pl.BlockSpec(
-                (1, block_k, _LANES), lambda bkv, kj, it: (bkv // KV, kj, 0)
+                (1, block_k, _SEG_LANES), lambda bkv, kj, it: (bkv // KV, kj, 0)
             ),
         ])
         dkv_operands.extend(segc)
@@ -653,8 +664,8 @@ def _dbias_call(
     operands = [qp, kp, vp, dop, lsep, dp, biasp]
     if segc is not None:
         in_specs.extend([
-            pl.BlockSpec((1, block_q, _LANES), qsmap),
-            pl.BlockSpec((1, block_k, _LANES), ksmap),
+            pl.BlockSpec((1, block_q, _SEG_LANES), qsmap),
+            pl.BlockSpec((1, block_k, _SEG_LANES), ksmap),
         ])
         operands.extend(segc)
     dbias = pl.pallas_call(
@@ -689,34 +700,35 @@ def _flash_core(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
     out, _ = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
         bias=bias, heads=heads,
-        segs=None if qseg is None else (qseg, kseg),
+        segc=_seg_carriers(qseg, kseg, block_q, block_k),
     )
     return out
 
 
 def _flash_core_fwd(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
                     block_q, block_k, interpret):
+    # Carriers are built once here and threaded through the residuals to
+    # every backward pallas_call (they are tiny at _SEG_LANES wide).
+    segc = _seg_carriers(qseg, kseg, block_q, block_k)
     out, lse = _fwd_call(
         qh, kh, vh, groups, causal, block_q, block_k, interpret,
-        bias=bias, heads=heads,
-        segs=None if qseg is None else (qseg, kseg),
+        bias=bias, heads=heads, segc=segc,
     )
-    return out, (qh, kh, vh, bias, qseg, kseg, out, lse)
+    return out, (qh, kh, vh, bias, segc, out, lse)
 
 
 def _flash_core_bwd(groups, heads, causal, block_q, block_k, interpret,
                     res, do):
-    qh, kh, vh, bias, qseg, kseg, out, lse = res
-    segs = None if qseg is None else (qseg, kseg)
+    qh, kh, vh, bias, segc, out, lse = res
     if bias is None:
         dq, dk, dv = _bwd_call(
             qh, kh, vh, do, out, lse, groups, causal, block_q, block_k,
-            interpret, heads=heads, segs=segs,
+            interpret, heads=heads, segc=segc,
         )
         return dq, dk, dv, None, None, None
     dq, dk, dv, dbias = _bwd_call(
         qh, kh, vh, do, out, lse, groups, causal, block_q, block_k, interpret,
-        bias=bias, heads=heads, segs=segs, want_dbias=True,
+        bias=bias, heads=heads, segc=segc, want_dbias=True,
     )
     # (a head-broadcast bias already accumulated over heads in-kernel)
     return dq, dk, dv, dbias.astype(bias.dtype), None, None

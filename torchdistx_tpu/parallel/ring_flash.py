@@ -38,7 +38,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.flash_attention import _LANES, _bwd_call, _fwd_call, _pad_seq, _round8
+from ..ops.flash_attention import (
+    _LANES,
+    _bwd_call,
+    _fwd_call,
+    _pad_seq,
+    _round8,
+    _seg_carrier,
+)
 from ._attn_wrap import wrap_seq_parallel_attn
 from .collectives import ppermute_next
 
@@ -67,11 +74,16 @@ def _ring_fwd_loop(
     BH, s, D = qh.shape
     t = kh.shape[1]
 
+    # The query carrier is loop-invariant: build it once, outside the
+    # ring loop; the key carrier depends on the step's column slice and
+    # is built per block (8-lane: a cheap broadcast).
+    qc = None if segs is None else _seg_carrier(segs[0], bq)
+
     def flash_block(k_cur, v_cur, blk_causal, bias_blk=None, seg_blk=None):
         out, lse3 = _fwd_call(
             qh, k_cur, v_cur, groups, blk_causal, bq, bk, interpret,
             bias=bias_blk, heads=heads,
-            segs=None if seg_blk is None else (segs[0], seg_blk),
+            segc=None if seg_blk is None else (qc, _seg_carrier(seg_blk, bk)),
         )
         return out.astype(jnp.float32), lse3[:, :s, 0]
 
@@ -163,11 +175,13 @@ def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
     lse3 = jnp.broadcast_to(lse_p[:, :, None], (BH, lse_p.shape[1], _LANES))
     delta3 = _delta_carrier(do, out, bq, lse3.shape)
 
+    qc = None if qseg is None else _seg_carrier(qseg, bq)
+
     def grads_block(k_cur, v_cur, blk_causal, bias_blk, seg_blk):
         r = _bwd_call(
             qh, k_cur, v_cur, do, out, lse3, groups, blk_causal, bq, bk,
             interpret, delta3=delta3, bias=bias_blk, heads=heads,
-            segs=None if seg_blk is None else (qseg, seg_blk),
+            segc=None if seg_blk is None else (qc, _seg_carrier(seg_blk, bk)),
             want_dbias=has_bias,
         )
         return (
