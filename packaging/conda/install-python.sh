@@ -1,10 +1,16 @@
 #!/usr/bin/env bash
-# torchdistx-tpu: the Python package.  Bundles its own copy of the
-# engine in torchdistx_tpu/_lib/ (setup.py runs `make native`; ctypes
-# falls back to pure Python where no compiler exists).
+# torchdistx-tpu: the Python package.  Bundles the engine from the SAME
+# shared build tree as -cc (one set of binaries across all four
+# packages; the -cc-debug symbols match the bundled lib's
+# gnu-debuglink).  TDX_SKIP_NATIVE_BUILD tells setup.py not to
+# recompile over the prebuilt copy.
 
 set -o errexit -o nounset -o pipefail
 
+BUILD_DIR="${TDX_CONDA_BUILD_DIR:-$SRC_DIR/build-conda}"
+
 cd "$SRC_DIR"
-make native || true
-"$PYTHON" -m pip install . -vv --no-deps --no-build-isolation
+mkdir -p torchdistx_tpu/_lib
+cp -L "$BUILD_DIR/lib/libtdxgraph.so" torchdistx_tpu/_lib/libtdxgraph.so
+TDX_SKIP_NATIVE_BUILD=1 \
+    "$PYTHON" -m pip install . -vv --no-deps --no-build-isolation

@@ -6,6 +6,7 @@ is compiled into the package's ``_lib`` directory at build time; the
 package remains fully functional without it (pure-Python fallback).
 """
 
+import os
 import shutil
 import subprocess
 from pathlib import Path
@@ -51,7 +52,12 @@ class build_native(Command):
 
 class build_py_with_native(build_py):
     def run(self):
-        if shutil.which("g++") is None:
+        if os.environ.get("TDX_SKIP_NATIVE_BUILD") == "1":
+            # The caller supplies a prebuilt engine in _lib/ (the conda
+            # pipeline's install-python.sh, which reuses the one shared
+            # RelWithDebInfo build so all packages ship the same binary).
+            print("native build skipped (TDX_SKIP_NATIVE_BUILD=1)")
+        elif shutil.which("g++") is None:
             # No compiler: a pure wheel (has_ext_modules False agrees).
             print("warning: native build skipped (no g++ on PATH)")
         else:

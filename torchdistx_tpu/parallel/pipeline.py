@@ -33,7 +33,7 @@ from jax import shard_map
 
 from ..models.configs import TransformerConfig
 from ..models.layers import Block, default_attention
-from .collectives import send_next
+from .collectives import send_next, send_prev
 
 
 def _sum_aux(tree) -> jax.Array:
@@ -204,6 +204,237 @@ def pipelined_decoder_apply(
     # final norm + head (replicated compute)
     logits = decomp.head(p, x)
     return (logits, aux) if return_aux else logits
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (one-forward-one-backward) schedule
+# ---------------------------------------------------------------------------
+
+
+def _mb_ce_sum(cfg, logits, tokens, segment_ids, denom):
+    """Next-token CE of ONE microbatch in SUM form over the GLOBAL valid
+    count ``denom`` — summing these across microbatches reproduces the
+    full-batch mean CE exactly (packed segments included), which is what
+    lets each microbatch's loss gradient be computed the moment its
+    forward finishes (the 1F1B requirement)."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    tgt = tokens[:, 1:]
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    if segment_ids is None:
+        return -jnp.sum(ll) / denom
+    valid = jnp.logical_and(
+        segment_ids[:, :-1] == segment_ids[:, 1:],
+        segment_ids[:, 1:] >= 0,
+    ).astype(jnp.float32)
+    return -jnp.sum(ll * valid) / denom
+
+
+def pipeline_train_1f1b(
+    cfg: TransformerConfig,
+    params,
+    tokens: jax.Array,  # [B, S]
+    mesh: Mesh,
+    *,
+    decomp,
+    n_microbatches: int = 4,
+    axis_name: str = "pp",
+    attn_fn=default_attention,
+    segment_ids: Optional[jax.Array] = None,
+):
+    """Fused forward+backward pipeline step under the 1F1B schedule.
+
+    Returns ``(metrics, grads)`` where ``grads`` matches the structure of
+    ``params`` — unlike the GPipe path this does NOT go through
+    ``jax.grad``: the schedule interleaves each microbatch's backward one
+    stage behind its forward, so stage ``s`` holds at most ``O(pp - s)``
+    in-flight microbatches of *recompute* state instead of every
+    microbatch's layer activations.  Mechanics per tick ``t``:
+
+    * forward microbatch ``f = t - stage`` (stage 0 feeds from the batch,
+      others from the rotated activation buffer), stashing the stage
+      INPUT only — the backward recomputes the stage interior under
+      ``jax.vjp`` (remat: ~1 extra forward per microbatch, the classic
+      1F1B-on-TPU tradeoff);
+    * backward microbatch ``b = t - (2(pp-1) - stage)``: the LAST stage
+      computes head+loss on the tick's own forward output (``b == f``
+      there) and seeds the cotangent; other stages consume the cotangent
+      rotated from the next stage, which arrives exactly one tick ahead
+      of use.  Block-param gradients accumulate stage-locally (sharded
+      over ``pp``); head/embed gradients ride a psum.
+
+    Total ticks: ``2(pp-1) + n_mb`` — the 1F1B bubble.  The MoE router
+    aux rides the same machinery: each forward's aux gets cotangent
+    ``1/n_mb`` in the stage vjp, matching the GPipe semantics.
+
+    The loss is the exact full-batch mean CE (see :func:`_mb_ce_sum`)
+    plus the microbatch-averaged aux, so metrics match the GPipe path.
+    """
+    p = params["params"]
+    assert "blocks" in p and "block" in p["blocks"], (
+        "pipeline_train_1f1b expects scan-stacked blocks at "
+        "params['params']['blocks']['block'] (the stock families' layout)"
+    )
+    B, S_in = tokens.shape
+    assert B % n_microbatches == 0
+    n_mb, mbs = n_microbatches, B // n_microbatches
+
+    # Embed (replicated) with vjp so dx cotangents flowing out of stage 0
+    # close the loop on the embedding parameters.
+    p_light = {k: v for k, v in p.items() if k != "blocks"}
+    x, embed_vjp = jax.vjp(lambda q: decomp.embed(q, tokens), p_light)
+    S = x.shape[1]
+    chain = _block_chain(cfg, attn_fn, decomp.angles(S), causal=decomp.causal)
+
+    x_mb = x.reshape(n_mb, mbs, S, cfg.d_model)
+    tok_mb = tokens.reshape(n_mb, mbs, S)
+    has_segs = segment_ids is not None
+    seg_mb = segment_ids.reshape(n_mb, mbs, S) if has_segs else None
+
+    # Global CE denominator, known before any backward starts (packed
+    # segments make it data-dependent, but it's a cheap elementwise
+    # reduction over the ids).
+    if has_segs:
+        denom = jnp.maximum(
+            jnp.sum(
+                jnp.logical_and(
+                    segment_ids[:, :-1] == segment_ids[:, 1:],
+                    segment_ids[:, 1:] >= 0,
+                ).astype(jnp.float32)
+            ),
+            1.0,
+        )
+    else:
+        denom = jnp.float32(B * (S - 1))
+
+    def head_loss(q, y, tok, segs):
+        return _mb_ce_sum(cfg, decomp.head(q, y), tok, segs, denom)
+
+    def schedule(stacked, q_light, x_mb, tok_mb, seg_mb):
+        n = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        is_last = stage == n - 1
+        T = 2 * (n - 1) + n_mb
+        # Circular input stash: stage s needs microbatch i's input from
+        # its forward (tick s+i) to its backward (tick 2(n-1)-s+i), a
+        # window of 2(n-1-s) ticks — so a DEPTH-sized buffer suffices
+        # and stashed-activation memory does not grow with n_mb.  (The
+        # dx_out buffer below is O(n_mb) by necessity: it IS the embed
+        # output's cotangent for the whole batch, the same size as the
+        # x_mb input itself.)
+        W = min(n_mb, 2 * (n - 1) + 1)
+
+        def tick(t, carry):
+            buf, dbuf, stash, g_blk, g_light, dx_out, ce_acc, aux_acc = carry
+
+            # ---- forward: microbatch f = t - stage -----------------------
+            f = t - stage
+            do_f = (f >= 0) & (f < n_mb)
+            fi = jnp.clip(f, 0, n_mb - 1)
+            inp = jnp.where(stage == 0, x_mb[fi], buf)
+            segs_f = seg_mb[fi] if has_segs else None
+            y, aux = chain(stacked, inp, segs_f)
+            slot_f = fi % W
+            stash = stash.at[slot_f].set(jnp.where(do_f, inp, stash[slot_f]))
+            aux_acc = aux_acc + jnp.where(do_f, aux, 0.0)
+
+            # ---- backward: microbatch b = t - (2(n-1) - stage) -----------
+            b = t - (2 * (n - 1) - stage)
+            do_b = (b >= 0) & (b < n_mb)
+            bi = jnp.clip(b, 0, n_mb - 1)
+            segs_b = seg_mb[bi] if has_segs else None
+
+            def seed_last(_):
+                # b == f at the last stage: head+loss on this tick's y.
+                ce, hvjp = jax.vjp(
+                    lambda q, yy: head_loss(q, yy, tok_mb[bi], segs_b),
+                    q_light, y,
+                )
+                dq, dy = hvjp(jnp.float32(1.0))
+                return ce, dy.astype(y.dtype), dq
+
+            def seed_mid(_):
+                return (
+                    jnp.float32(0.0),
+                    dbuf,
+                    jax.tree.map(jnp.zeros_like, q_light),
+                )
+
+            ce_j, dy, dq = lax.cond(is_last, seed_last, seed_mid, None)
+            ce_acc = ce_acc + jnp.where(do_b, ce_j, 0.0)
+            g_light = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, 0), g_light, dq
+            )
+
+            # Recompute the stage interior and pull gradients through it;
+            # the aux output's cotangent is 1/n_mb (microbatch average).
+            _, cvjp = jax.vjp(
+                lambda sp, xx: chain(sp, xx, segs_b), stacked, stash[bi % W]
+            )
+            d_sp, dx = cvjp((dy, jnp.float32(1.0 / n_mb)))
+            g_blk = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, 0), g_blk, d_sp
+            )
+            dx_out = dx_out.at[bi].set(
+                jnp.where(do_b & (stage == 0), dx, dx_out[bi])
+            )
+
+            # ---- rotate: activations forward, cotangents backward --------
+            buf = send_next(y, axis_name)
+            dbuf = send_prev(dx, axis_name)
+            return (buf, dbuf, stash, g_blk, g_light, dx_out, ce_acc, aux_acc)
+
+        carry0 = (
+            jnp.zeros_like(x_mb[0]),
+            jnp.zeros_like(x_mb[0]),
+            jnp.zeros((W, *x_mb.shape[1:]), x_mb.dtype),
+            jax.tree.map(jnp.zeros_like, stacked),
+            jax.tree.map(jnp.zeros_like, q_light),
+            jnp.zeros_like(x_mb),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+        )
+        _, _, _, g_blk, g_light, dx_out, ce, aux = lax.fori_loop(
+            0, T, tick, carry0, unroll=False
+        )
+        # Stage-local block grads stay sharded over pp (out_spec);
+        # everything else reduces: head grads live on the last stage,
+        # dx on stage 0, ce on the last stage, aux on all.
+        g_light = lax.psum(g_light, axis_name)
+        dx_out = lax.psum(
+            jnp.where(stage == 0, dx_out, jnp.zeros_like(dx_out)), axis_name
+        )
+        ce = lax.psum(ce, axis_name)
+        aux = lax.psum(aux, axis_name) / n_mb
+        return g_blk, g_light, dx_out, ce, aux
+
+    pp_fn = shard_map(
+        schedule,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P(), P()),
+        out_specs=(P(axis_name), P(), P(), P(), P()),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    g_blk, g_light, dx_out, ce, aux = pp_fn(
+        decomp.block_params(p), p_light, x_mb, tok_mb, seg_mb
+    )
+
+    # Close the loop through the replicated embed.
+    (g_embed,) = embed_vjp(dx_out.reshape(B, S, cfg.d_model).astype(x.dtype))
+    g_light = jax.tree.map(jnp.add, g_light, g_embed)
+    # Mirror the full variables structure (MoE inits carry a "losses"
+    # collection next to "params"; optax needs grads ≅ params).
+    grads = {
+        k: (
+            {**g_light, "blocks": {"block": g_blk}}
+            if k == "params"
+            else jax.tree.map(jnp.zeros_like, v)
+        )
+        for k, v in params.items()
+    }
+
+    loss = ce + aux
+    return {"loss": loss, "ce": ce, "aux": aux}, grads
 
 
 def pipeline_plan_overrides(axis_name: str = "pp"):

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import TransformerConfig
 from ..models.layers import default_attention
-from .pipeline import _sum_aux, pipelined_decoder_apply
+from .pipeline import _sum_aux, pipeline_train_1f1b, pipelined_decoder_apply
 
 
 def lm_cross_entropy(
@@ -55,6 +55,7 @@ def make_train_step(
     batch_axes=("dp", "fsdp"),
     pipeline: bool = False,
     pipeline_axis: str = "pp",
+    pipeline_schedule: str = "gpipe",
     n_microbatches: int = 4,
     attn_fn=None,
     donate: bool = True,
@@ -64,7 +65,14 @@ def make_train_step(
     ``train_step(state, tokens) -> (state, metrics)`` is jitted with the
     batch sharded over the data axes; everything else follows from the
     parameter shardings set at materialization.  With ``pipeline=True``
-    the blocks run the GPipe schedule over ``pipeline_axis``.
+    the blocks run over ``pipeline_axis`` under ``pipeline_schedule``:
+
+    * ``"gpipe"`` — forward-only schedule, gradients via ``jax.grad``
+      transposing the whole loop (simple; stores every microbatch's
+      layer activations);
+    * ``"1f1b"`` — fused forward+backward one-forward-one-backward
+      schedule (:func:`~torchdistx_tpu.parallel.pipeline.pipeline_train_1f1b`):
+      bounded in-flight state via stage-input stash + recompute.
     """
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     baxes = tuple(a for a in batch_axes if a in mesh.axis_names)
@@ -112,11 +120,30 @@ def make_train_step(
         ce = lm_cross_entropy(logits, tokens, segment_ids)
         return ce + aux, (ce, aux)
 
+    if pipeline and pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pipeline_schedule must be 'gpipe' or '1f1b', got "
+            f"{pipeline_schedule!r}"
+        )
+    use_1f1b = pipeline and pipeline_schedule == "1f1b"
+
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, tokens, segment_ids=None):
-        (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], tokens, segment_ids
-        )
+        if use_1f1b:
+            # The 1F1B schedule produces gradients directly (no
+            # jax.grad over the schedule — backwards are interleaved
+            # into it).
+            metrics, grads = pipeline_train_1f1b(
+                cfg, state["params"], tokens, mesh, decomp=decomp,
+                n_microbatches=n_microbatches, axis_name=pipeline_axis,
+                attn_fn=attn_fn or default_attention,
+                segment_ids=segment_ids,
+            )
+            loss, ce, aux = metrics["loss"], metrics["ce"], metrics["aux"]
+        else:
+            (loss, (ce, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"], tokens, segment_ids)
         updates, opt_state = opt.update(grads, state["opt"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         new_state = {"params": params, "opt": opt_state, "step": state["step"] + 1}
