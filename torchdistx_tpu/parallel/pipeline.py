@@ -44,6 +44,29 @@ def _sum_aux(tree) -> jax.Array:
     return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
 
 
+def valid_next_token_mask(segment_ids: jax.Array) -> jax.Array:
+    """[B, S-1] f32 mask of valid next-token targets for packed ids:
+    positions whose next token crosses a document boundary are excluded,
+    and a NEGATIVE id marks the padded tail (also excluded).  The single
+    definition every CE path shares — the GPipe/1F1B/dense loss
+    agreement depends on them using the same predicate."""
+    return jnp.logical_and(
+        segment_ids[:, :-1] == segment_ids[:, 1:],
+        segment_ids[:, 1:] >= 0,
+    ).astype(jnp.float32)
+
+
+def default_decomposition(cfg: TransformerConfig, attn_fn=default_attention):
+    """Stock-family decomposition fallback: rope → Llama layout, else
+    GPT-2.  Custom families must export their own
+    (``model.pipeline_decomposition()``)."""
+    from ..models.gpt2 import GPT2Model
+    from ..models.llama import LlamaModel
+
+    family = LlamaModel if cfg.positions == "rope" else GPT2Model
+    return family(cfg, attn_fn=attn_fn).pipeline_decomposition()
+
+
 def pipeline_forward(
     stage_fn: Callable,
     stage_params,
@@ -159,9 +182,6 @@ def pipelined_decoder_apply(
     else GPT-2) — custom families must pass their own.
     """
     if decomp is None:
-        from ..models.gpt2 import GPT2Model
-        from ..models.llama import LlamaModel
-
         if positions is not None and positions != cfg.positions:
             import warnings
 
@@ -171,8 +191,7 @@ def pipelined_decoder_apply(
                 f"Pass decomp= (model.pipeline_decomposition()) to override "
                 f"the family explicitly."
             )
-        family = LlamaModel if cfg.positions == "rope" else GPT2Model
-        decomp = family(cfg, attn_fn=attn_fn).pipeline_decomposition()
+        decomp = default_decomposition(cfg, attn_fn)
 
     p = params["params"]
     B = tokens.shape[0]  # tokens [B, S] or images [B, H, W, C]
@@ -211,7 +230,7 @@ def pipelined_decoder_apply(
 # ---------------------------------------------------------------------------
 
 
-def _mb_ce_sum(cfg, logits, tokens, segment_ids, denom):
+def _mb_ce_sum(logits, tokens, segment_ids, denom):
     """Next-token CE of ONE microbatch in SUM form over the GLOBAL valid
     count ``denom`` — summing these across microbatches reproduces the
     full-batch mean CE exactly (packed segments included), which is what
@@ -222,11 +241,7 @@ def _mb_ce_sum(cfg, logits, tokens, segment_ids, denom):
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     if segment_ids is None:
         return -jnp.sum(ll) / denom
-    valid = jnp.logical_and(
-        segment_ids[:, :-1] == segment_ids[:, 1:],
-        segment_ids[:, 1:] >= 0,
-    ).astype(jnp.float32)
-    return -jnp.sum(ll * valid) / denom
+    return -jnp.sum(ll * valid_next_token_mask(segment_ids)) / denom
 
 
 def pipeline_train_1f1b(
@@ -295,19 +310,13 @@ def pipeline_train_1f1b(
     # reduction over the ids).
     if has_segs:
         denom = jnp.maximum(
-            jnp.sum(
-                jnp.logical_and(
-                    segment_ids[:, :-1] == segment_ids[:, 1:],
-                    segment_ids[:, 1:] >= 0,
-                ).astype(jnp.float32)
-            ),
-            1.0,
+            jnp.sum(valid_next_token_mask(segment_ids)), 1.0
         )
     else:
         denom = jnp.float32(B * (S - 1))
 
     def head_loss(q, y, tok, segs):
-        return _mb_ce_sum(cfg, decomp.head(q, y), tok, segs, denom)
+        return _mb_ce_sum(decomp.head(q, y), tok, segs, denom)
 
     def schedule(stacked, q_light, x_mb, tok_mb, seg_mb):
         n = lax.psum(1, axis_name)

@@ -20,7 +20,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import TransformerConfig
 from ..models.layers import default_attention
-from .pipeline import _sum_aux, pipeline_train_1f1b, pipelined_decoder_apply
+from .pipeline import (
+    _sum_aux,
+    default_decomposition,
+    pipeline_train_1f1b,
+    pipelined_decoder_apply,
+    valid_next_token_mask,
+)
 
 
 def lm_cross_entropy(
@@ -39,10 +45,7 @@ def lm_cross_entropy(
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     if segment_ids is None:
         return -jnp.mean(ll)
-    valid = jnp.logical_and(
-        segment_ids[:, :-1] == segment_ids[:, 1:],
-        segment_ids[:, 1:] >= 0,
-    ).astype(jnp.float32)
+    valid = valid_next_token_mask(segment_ids)
     return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
@@ -120,12 +123,24 @@ def make_train_step(
         ce = lm_cross_entropy(logits, tokens, segment_ids)
         return ce + aux, (ce, aux)
 
-    if pipeline and pipeline_schedule not in ("gpipe", "1f1b"):
+    if pipeline_schedule not in ("gpipe", "1f1b"):
         raise ValueError(
             f"pipeline_schedule must be 'gpipe' or '1f1b', got "
             f"{pipeline_schedule!r}"
         )
+    if pipeline_schedule != "gpipe" and not pipeline:
+        # Silently training the dense path while the caller believes
+        # they asked for 1F1B would invalidate whatever they measure.
+        raise ValueError(
+            f"pipeline_schedule={pipeline_schedule!r} requires "
+            f"pipeline=True (got pipeline=False)."
+        )
     use_1f1b = pipeline and pipeline_schedule == "1f1b"
+    if use_1f1b and decomp is None:
+        # Same stock-family fallback the GPipe path gets inside
+        # pipelined_decoder_apply; custom families must export
+        # model.pipeline_decomposition().
+        decomp = default_decomposition(cfg, attn_fn or default_attention)
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, tokens, segment_ids=None):
