@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import torch
 
 from .._graph import CONTEXT_KEY, OpNode, get_fake_context
@@ -76,9 +77,13 @@ class TraceContext:
         # (a recording made under torch.set_default_dtype resolves factory
         # ops recorded without an explicit dtype= the way torch would).
         self.default_dtype = None
+        # The node being interpreted — impls that need its recorded
+        # output geometry (aten.resize_) read it here.
+        self.node = None
 
     def set_node(self, node: "OpNode") -> None:
         self._knr = node.key_nr
+        self.node = node
         self._set_default_dtype(node)
 
     def _set_default_dtype(self, node: "OpNode") -> None:
@@ -107,6 +112,7 @@ class _BatchedTraceContext(TraceContext):
 
     def set_node(self, node: "OpNode") -> None:
         self._knr = self._knr_vec[self._local[id(node)]]
+        self.node = node
         self._set_default_dtype(node)
 
 
@@ -387,16 +393,34 @@ def interpret_node(node: OpNode, env: Dict, ctx: TraceContext) -> None:
         env[(id(node), 0)] = box
     elif kind == "view":
         box = _first_dep_box(args, env, node.dependencies)
-        if name == "aten.as_strided.default":
-            # as_strided is STORAGE-relative, not view-relative: resolve
-            # to the root box.  A factory root's logical value spans the
-            # storage contiguously; an OP-OUTPUT root can be dense but
-            # permuted (torch preserves input striding), in which case a
-            # storage-order adapter scatters the logical value into
-            # physical order first (soak seed 765331).
+        if name in ("aten.as_strided.default", "aten.resize_.default"):
+            # as_strided and resize_ are STORAGE-relative, not
+            # view-relative: resolve to the root box.  A factory root's
+            # logical value spans the storage contiguously; an OP-OUTPUT
+            # root can be dense but permuted (torch preserves input
+            # striding), in which case a storage-order adapter scatters
+            # the logical value into physical order first (soak seed
+            # 765331).
             while isinstance(box, ViewBox):
                 box = box.base
             geom = _live_root_geom(node)
+            if name == "aten.resize_.default":
+                # A growing resize_ reads storage the root box does not
+                # cover (fresh elements are uninitialized garbage in
+                # eager torch anyway) — no JAX lowering.
+                capacity = geom[3] if geom is not None else int(box.read().size)
+                og = node.out_geom.get(0)
+                top = (
+                    og[2] + int(np.prod(og[0])) if og is not None
+                    else int(np.prod([int(s) for s in node.op.args[1]]))
+                )
+                if top > capacity:
+                    raise NotImplementedError(
+                        f"aten.resize_ grows the storage ({top} > "
+                        f"{capacity} elements; the new tail is "
+                        f"uninitialized) — materialize this tensor with "
+                        f"the eager torch ReplayTarget instead."
+                    )
             if geom is not None and not _c_contiguous(geom):
                 from .ops import strided_lens
 

@@ -800,6 +800,66 @@ def _squeeze_all(ctx, base_shape, **kw):
     )
 
 
+@_reg("aten.squeeze.dims", "view")
+def _squeeze_dims(ctx, base_shape, dims, **kw):
+    nd = len(base_shape)
+    drop = tuple(
+        d for d in ((dd + nd if dd < 0 else dd) for dd in dims)
+        if base_shape[d] == 1
+    )
+    if not drop:
+        return (lambda b: b), (lambda b, v: v)
+    return (
+        lambda b: jnp.squeeze(b, drop),
+        lambda b, v: jnp.reshape(v, b.shape),
+    )
+
+
+@_reg("aten.resize_.default", "view")
+def _resize_(ctx, base_shape, size, **kw):
+    """In-place resize: the result reads the tensor's STORAGE linearly
+    (C-contiguous at the tensor's storage offset) regardless of the
+    prior view's layout — a storage-relative lens like as_strided
+    (interpret_node routes both through the root box + storage-order
+    adapter).  Geometry comes from the recorded post-op meta
+    (ctx.node.out_geom, stamped by the impl-swapped fake wrapper);
+    absent means C-contiguous spanning at offset 0."""
+    node = getattr(ctx, "node", None)
+    geom = node.out_geom.get(0) if node is not None else None
+    if geom is not None:
+        gsize, gstride, goffset, _ = geom
+        flat_fwd, flat_bwd = strided_lens(gsize, gstride, goffset)
+    else:
+        gsize = tuple(int(s) for s in size)
+        stride, acc = [], 1
+        for s in reversed(gsize):
+            stride.append(acc)
+            acc *= max(int(s), 1)
+        flat_fwd, flat_bwd = strided_lens(gsize, tuple(reversed(stride)), 0)
+
+    def fwd(b):
+        return flat_fwd(jnp.ravel(b))
+
+    def bwd(b, v):
+        return flat_bwd(jnp.ravel(b), v).reshape(b.shape)
+
+    return fwd, bwd
+
+
+# In-place geometry variants (t_/transpose_/squeeze_/unsqueeze_): the
+# logical transform is identical to the out-of-place view — the fake
+# wrapper re-wraps to the new geometry at record time, the graph makes
+# later readers depend on this node's output, and the op writes no
+# storage, so a view lens over the input box is exactly the eager
+# semantics.
+TABLE["aten.t_.default"] = TABLE["aten.t.default"]
+TABLE["aten.transpose_.default"] = TABLE["aten.transpose.int"]
+TABLE["aten.squeeze_.default"] = TABLE["aten.squeeze.default"]
+TABLE["aten.squeeze_.dim"] = TABLE["aten.squeeze.dim"]
+TABLE["aten.squeeze_.dims"] = TABLE["aten.squeeze.dims"]
+TABLE["aten.unsqueeze_.default"] = TABLE["aten.unsqueeze.default"]
+
+
 @_reg("aten.expand.default", "view")
 def _expand(ctx, base_shape, size, **kw):
     # expand may add leading dims; -1 entries align with trailing dims.
