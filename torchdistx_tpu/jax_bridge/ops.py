@@ -782,6 +782,9 @@ def _split_with_sizes(ctx, base_shape, sizes, dim=0, **kw):
 
 @_reg("aten.squeeze.dim", "view")
 def _squeeze(ctx, base_shape, dim, **kw):
+    if not base_shape:
+        # 0-d: torch defines squeeze(dim) with dim in [-1, 0] as a no-op.
+        return (lambda b: b), (lambda b, v: v)
     if dim < 0:
         dim += len(base_shape)
     if base_shape[dim] != 1:
@@ -803,6 +806,9 @@ def _squeeze_all(ctx, base_shape, **kw):
 @_reg("aten.squeeze.dims", "view")
 def _squeeze_dims(ctx, base_shape, dims, **kw):
     nd = len(base_shape)
+    if nd == 0:
+        # 0-d: torch defines squeeze over explicit dims as a no-op.
+        return (lambda b: b), (lambda b, v: v)
     drop = tuple(
         d for d in ((dd + nd if dd < 0 else dd) for dd in dims)
         if base_shape[d] == 1
