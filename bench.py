@@ -313,16 +313,45 @@ def _flash_phase(mode: str) -> dict:
     attention flavor pays a single Mosaic/XLA compile — cold compiles
     through the tunnel are the dominant cost.
     """
+    # Autotune winners persist next to the bench cache (committed), so a
+    # later round on the same device kind reuses them with zero cost.
+    os.environ.setdefault("TDX_CACHE_DIR", BCACHE_DIR)
     jax = _init_jax(cache=True)
     import jax.numpy as jnp
     from jax import lax
 
     from torchdistx_tpu.models.layers import default_attention
-    from torchdistx_tpu.ops.flash_attention import flash_attention
+    from torchdistx_tpu.ops.flash_attention import make_flash_attention
 
     # Overridable so the phases can be driven end-to-end off-accelerator
     # (pallas interpret mode is far too slow at the real shape on CPU).
     B, H, S, D = _env_ints("TDX_FLASH_SHAPE", "4,16,2048,64", 4)
+
+    # Block sizes: the defaults (1024x1024) are the measured winner on
+    # v5e at this shape (round-2 hand search, now the autotuner's job).
+    # On an UNKNOWN accelerator kind — or when TDX_BENCH_TUNE=1 — run
+    # the cached autotuner so the phase reports the chip's best blocks
+    # instead of another chip's; on known kinds skip it (each candidate
+    # costs a cold Mosaic compile through the tunnel).
+    kind = jax.devices()[0].device_kind
+    bq = bk = 1024
+    autotuned = False
+    known = any(s in kind.lower() for s in ("v5 lite", "v5e", "v5litepod"))
+    if jax.default_backend() != "cpu" and (
+        os.environ.get("TDX_BENCH_TUNE") == "1" or not known
+    ):
+        from torchdistx_tpu.ops.autotune import tune_flash_blocks
+
+        try:
+            bq, bk = tune_flash_blocks(
+                batch=B, seq_len=S, heads=H, head_dim=D,
+                causal=(mode != "bias"), dtype=jnp.bfloat16,
+                workload=mode,  # time THIS phase's kernels, not fwd's
+            )
+            autotuned = True
+        except Exception:
+            pass  # defaults are sound on every kind tested so far
+    flash_attention = make_flash_attention(block_q=bq, block_k=bk)
     q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
@@ -397,7 +426,6 @@ def _flash_phase(mode: str) -> dict:
 
     t_flash = bench(make_step(flash_attention))
     t_ref = bench(make_step(default_attention))
-    kind = jax.devices()[0].device_kind
     peak = _peak_tflops(kind)
     out = {
         "flash_ms": round(t_flash * 1e3, 3),
@@ -406,6 +434,8 @@ def _flash_phase(mode: str) -> dict:
         "ref_tflops": round(flops / t_ref / 1e12, 2),
         "speedup": round(t_ref / t_flash, 3),
         "device_kind": kind,
+        "blocks": [bq, bk],
+        **({"autotuned": True} if autotuned else {}),
     }
     if peak is not None:
         # Achieved / peak dense-bf16 — the MFU the charter judges.

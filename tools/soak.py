@@ -27,7 +27,8 @@ import traceback
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-MODES = ("whole", "single", "bridge", "bridge_single", "serialize")
+MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
+         "geom", "geom_single", "geom_bridge")
 
 
 def _init_worker() -> None:
@@ -82,6 +83,16 @@ def _run_seed(mode: str, seed: int):
             F._jax_bridge_oracle(seed, allow_data_ops=True)
         elif mode == "bridge_single":
             F._jax_bridge_oracle(seed, allow_data_ops=True, single_pick=True)
+        elif mode == "geom":
+            # Geometry-changing in-place ops + any-donor .data + RNG +
+            # value reads: whole-program oracle (seed protocol: stream
+            # runs uninterrupted through recording-time flushes).
+            F.test_geometry_ops_whole_program_matches_eager(seed)
+        elif mode == "geom_single":
+            F.test_geometry_ops_single_tensor_matches_eager(seed)
+        elif mode == "geom_bridge":
+            F._jax_bridge_oracle(seed, allow_data_ops=True,
+                                 allow_geom_ops=True)
         elif mode == "serialize":
             import tempfile
             from pathlib import Path

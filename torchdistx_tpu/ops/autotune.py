@@ -129,10 +129,20 @@ def tune_flash_blocks(
     candidates: Sequence[Tuple[int, int]] = DEFAULT_CANDIDATES,
     use_cache: bool = True,
     interpret: Optional[bool] = None,
+    workload: str = "fwd",
 ) -> Tuple[int, int]:
     """Measure ``candidates`` on the live device and return the fastest
     ``(block_q, block_k)``, cached per (device kind, shape, dtype,
-    causality, interpret).
+    causality, interpret, workload).
+
+    ``workload`` selects WHAT each candidate times — the winner for one
+    workload need not win another, so it is part of the cache key:
+
+    * ``"fwd"``  — the forward kernel;
+    * ``"bwd"``  — forward + gradients wrt (q, k, v): the dq and dkv
+      backward kernels dominate a training step;
+    * ``"bias"`` — forward with an additive [H, S, S] f32 bias operand
+      (the T5 relative-position stream).
 
     Oversized candidates are clamped to the (8-rounded) sequence length,
     mirroring :func:`flash_attention`'s own clamping, then deduplicated —
@@ -142,12 +152,16 @@ def tune_flash_blocks(
     re-measured."""
     from .flash_attention import _round8, flash_attention
 
+    if workload not in ("fwd", "bwd", "bias"):
+        raise ValueError(f"unknown workload {workload!r}")
     kv = kv_heads or heads
     shape = (batch, seq_len, heads, kv, head_dim)
     device_kind = jax.devices()[0].device_kind
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     key = _cache_key(device_kind, shape, dtype, causal, interpret)
+    if workload != "fwd":  # legacy keys stay valid for the fwd workload
+        key += f"|workload={workload}"
 
     cap = _round8(seq_len)
     clamped = tuple(dict.fromkeys(
@@ -163,13 +177,30 @@ def tune_flash_blocks(
     q = jax.random.normal(jax.random.PRNGKey(0), (batch, seq_len, heads, head_dim), dtype)
     k = jax.random.normal(jax.random.PRNGKey(1), (batch, seq_len, kv, head_dim), dtype)
     v = jax.random.normal(jax.random.PRNGKey(2), (batch, seq_len, kv, head_dim), dtype)
+    bias = (
+        jax.random.normal(jax.random.PRNGKey(3), (heads, seq_len, seq_len),
+                          jnp.float32)
+        if workload == "bias" else None
+    )
 
     best, best_t = None, float("inf")
     for bq, bk in clamped:
 
         def fn(q, k, v, bq=bq, bk=bk):
+            if workload == "bwd":
+                # Time what a training step runs: fwd + dq/dkv kernels.
+                # dk/dv feed the return (summed in) so neither backward
+                # kernel can be dead-code-eliminated.
+                dq, dk, dv = jax.grad(
+                    lambda qq, kk, vv: flash_attention(
+                        qq, kk, vv, causal=causal, block_q=bq, block_k=bk,
+                        interpret=interpret,
+                    ).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2),
+                )(q, k, v)
+                return dq + (dk.sum() + dv.sum()).astype(dq.dtype)
             return flash_attention(
-                q, k, v, causal=causal, block_q=bq, block_k=bk,
+                q, k, v, causal=causal, bias=bias, block_q=bq, block_k=bk,
                 interpret=interpret,
             )
 
