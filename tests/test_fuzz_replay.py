@@ -430,15 +430,19 @@ def test_jax_bridge_replay_matches_eager(seed):
 
 def _f64_tainted(steps):
     """Pool indices whose VALUES depend on a float64 computation —
-    tracked through derivation and storage aliasing, so the f32
-    tolerance (below) applies only where the f64→f32 degradation can
-    actually reach, and every other output keeps bitwise coverage."""
+    tracked through derivation, storage aliasing, AND python-object
+    identity (in-place ops append the same object to the pool under a
+    new index; set_data rebinds that object for EVERY index it occupies
+    — found by the geom-mode soak, seed 3001006, where a dtype-changing
+    set_data donor reached an index only object identity connects)."""
     taint: list = []   # per pool index: value is f64-derived
-    group: list = []   # alias-group id per pool index
+    group: list = []   # storage-alias-group id per pool index
+    obj: list = []     # python-object id per pool index
 
-    def new(g=None, t=False):
+    def new(g=None, t=False, o=None):
         group.append(g if g is not None else len(group))
         taint.append(t)
+        obj.append(o if o is not None else len(obj))
 
     def taint_group(g):
         for i, gi in enumerate(group):
@@ -460,12 +464,12 @@ def _f64_tainted(steps):
             new(group[step[1]], taint[step[1]])
         elif kind in ("inplace_scalar", "uniform_", "normal_", "geom_inplace"):
             i = step[1]
-            new(group[i], taint[i])
+            new(group[i], taint[i], obj[i])  # same object back in the pool
         elif kind == "inplace_binary":
             _, i, j, op = step
             if taint[j] and not taint[i]:
                 taint_group(group[i])
-            new(group[i], taint[i])
+            new(group[i], taint[i], obj[i])
         elif kind in ("outofplace", "clone", "deepcopy"):
             new(t=taint[step[1]])
         elif kind == "cat":
@@ -476,10 +480,13 @@ def _f64_tainted(steps):
             new(t=taint[i] or "float64" in str(dt))
         elif kind == "set_data":
             _, i, j = step
-            # pool[i] rebinds to pool[j]'s storage (no data is written:
-            # i simply aliases j from here on)
-            group[i], taint[i] = group[j], taint[j]
-            new(group[j], taint[j])
+            # pool[i] rebinds to pool[j]'s storage (no data is written).
+            # The rebound thing is the python OBJECT — every pool index
+            # occupied by it re-groups, not just index i.
+            for k in range(len(obj)):
+                if obj[k] == obj[i]:
+                    group[k], taint[k] = group[j], taint[j]
+            new(group[j], taint[j], obj[i])
         else:  # pragma: no cover - keep in sync with _gen_program
             raise AssertionError(f"untracked step kind {kind!r}")
     return {i for i, t in enumerate(taint) if t}
@@ -537,8 +544,12 @@ def _jax_bridge_oracle(seed, *, allow_data_ops, allow_geom_ops=False,
             assert np.array_equal(e, j), msg
 
 
-@pytest.mark.parametrize("seed", range(3200, 3200 + 16))
+@pytest.mark.parametrize("seed", list(range(3200, 3200 + 16)) + [3001006])
 def test_jax_bridge_geometry_ops_match_eager(seed):
+    # 3001006: geom-soak find — a dtype-changing set_data donor reaches
+    # other pool indices of the same python object (in-place ops append
+    # the same object); the f64-taint tracker must follow object
+    # identity, not just the assigned index.
     # Geometry-changing in-place ops and metadata-changing .data through
     # the Box/lens interpreter: t_/transpose_/squeeze_/unsqueeze_ are
     # view lenses over the input box; resize_ is a storage-relative lens
