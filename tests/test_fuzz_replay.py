@@ -641,10 +641,13 @@ def test_serialize_roundtrip_matches_eager(seed, tmp_path):
     from torchdistx_tpu.serialize import load_recording, save_recording
 
     # Half the seeds include .data ops so synthetic tdx::set_data nodes
-    # flow through the codec; value reads may early-materialize chains,
-    # which save_recording rejects -> skip path below.
+    # flow through the codec; a third add geometry-changing in-place ops
+    # (so every sixth seed can also produce metadata-changing set_data
+    # donors — both flags required).  Value reads may early-materialize
+    # chains, which save_recording rejects -> skip path below.
     steps = _gen_program(
-        random.Random(seed), allow_rng_ops=False, allow_data_ops=seed % 2 == 0
+        random.Random(seed), allow_rng_ops=False,
+        allow_data_ops=seed % 2 == 0, allow_geom_ops=seed % 3 == 0,
     )
     eager = run(steps)
     fakes = deferred_init(run, steps)
