@@ -320,6 +320,94 @@ class TestExportedInit:
         for k in live:
             assert np.array_equal(np.asarray(live[k]), np.asarray(got[k])), k
 
+    def test_sharded_export_executes_and_matches_live(self):
+        # The login-host artifact: export the init SHARDED over the
+        # 8-device mesh (for the CPU platform so this host can run it),
+        # deserialize, execute — values and shardings must match live
+        # sharded materialization.
+        from torchdistx_tpu.jax_bridge.export import _MAGIC, export_sharded_init
+        import json as _json
+        import struct as _struct
+        from jax import export as jax_export
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(8, 16)
+                self.b = nn.Embedding(32, 8)
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        m = deferred_init(M)
+        live = materialize_params_jax(
+            named_fake_tensors(m), mesh=mesh, plan=fsdp_plan(min_size=16), seed=7
+        )
+
+        m2 = deferred_init(M)
+        payload, names = export_sharded_init(
+            m2, mesh=mesh, plan=fsdp_plan(min_size=16), platforms=("cpu",)
+        )
+        assert payload[:8] == _MAGIC
+        (hlen,) = _struct.unpack("<I", payload[8:12])
+        header = _json.loads(payload[12 : 12 + hlen].decode())
+        assert header["names"] == names
+        assert header["nr_devices"] == 8
+        exp = jax_export.deserialize(payload[12 + hlen :])
+        assert exp.nr_devices == 8
+        # The pod side: load_exported_init handles the n-device calling
+        # context itself (jit over the first n local devices).
+        import tempfile
+        from pathlib import Path
+
+        from torchdistx_tpu.jax_bridge import load_exported_init
+
+        with tempfile.TemporaryDirectory() as d:
+            p = Path(d) / "sharded.tdxe"
+            p.write_bytes(payload)
+            run, names2 = load_exported_init(p)
+        assert names2 == names
+        outs = run(jax.random.PRNGKey(7))
+        got = dict(zip(names, outs))
+        for k in live:
+            assert np.array_equal(np.asarray(live[k]), np.asarray(got[k])), k
+
+    def test_sharded_export_cross_platform_tpu(self):
+        # The real direction: a TPU 64-logical-device program generated
+        # on this CPU-only host (execution needs the pod; the export
+        # must embed the right device count and platform).
+        from torchdistx_tpu.jax_bridge.export import export_sharded_init
+        import json as _json
+        import struct as _struct
+        from jax import export as jax_export
+
+        m = deferred_init(nn.Linear, 16, 16)
+        mesh = make_mesh({"fsdp": 8})
+        payload, names = export_sharded_init(
+            {"w": m.weight, "b": m.bias}, mesh=mesh,
+            plan=fsdp_plan(min_size=16), platforms=("tpu",),
+        )
+        (hlen,) = _struct.unpack("<I", payload[8:12])
+        assert _json.loads(payload[12 : 12 + hlen].decode())["platforms"] == ["tpu"]
+        exp = jax_export.deserialize(payload[12 + hlen :])
+        assert exp.nr_devices == 8
+        assert tuple(exp.platforms) == ("tpu",)
+
+    def test_sharded_export_too_few_devices_rejected(self, tmp_path):
+        # A 999-device program on this 8-device host: friendly error at
+        # load, before deserialization (nr_devices rides the header).
+        import json as _json
+        import struct as _struct
+
+        from torchdistx_tpu.jax_bridge import load_exported_init
+        from torchdistx_tpu.jax_bridge.export import _MAGIC
+
+        header = _json.dumps(
+            {"names": [], "platforms": ["cpu"], "nr_devices": 999}
+        ).encode()
+        p = tmp_path / "big.tdxe"
+        p.write_bytes(_MAGIC + _struct.pack("<I", len(header)) + header + b"XX")
+        with pytest.raises(ValueError, match="999-device"):
+            load_exported_init(p)
+
     def test_bad_file_rejected(self, tmp_path):
         from torchdistx_tpu.jax_bridge import load_exported_init
 

@@ -17,9 +17,11 @@ Python (`jax.export` embeds the StableHLO + calling convention).
 Complements :mod:`torchdistx_tpu.serialize` (which ships the *recording*
 — retraced and compiled at destination, sharding-flexible): the export
 ships the *compiled program* — zero destination compile, fixed layout.
-Exports are single-device programs; shard after load (``jax.device_put``
-with a ``NamedSharding``) or use ``materialize_params_jax`` on a live
-mesh when materialize-time sharding is needed.
+:func:`export_init` produces a single-device program (shard after load,
+or use ``materialize_params_jax`` on a live mesh);
+:func:`export_sharded_init` bakes a mesh + plan IN, producing the
+n-device SPMD program itself — parameters materialize already sharded,
+and ``load_exported_init`` runs either flavor.
 """
 
 from __future__ import annotations
@@ -34,7 +36,12 @@ import torch
 from ..fake import is_fake
 from .compile import build_init_fn
 
-__all__ = ["export_init", "save_exported_init", "load_exported_init"]
+__all__ = [
+    "export_init",
+    "export_sharded_init",
+    "save_exported_init",
+    "load_exported_init",
+]
 
 _MAGIC = b"TDXEXP01"
 
@@ -67,9 +74,20 @@ def export_init(
     exp = jax_export.export(jax.jit(init_fn), platforms=list(platforms))(
         jax.random.PRNGKey(0)
     )
+    return _wrap_payload(exp, names, platforms), names
+
+
+def _wrap_payload(exp, names: List[str], platforms: Sequence[str]) -> bytes:
+    """The shared container: MAGIC + JSON header + serialized export.
+    ``nr_devices`` rides the header so load can give a friendly error
+    before deserializing a program the host cannot run."""
     blob = exp.serialize()
-    header = json.dumps({"names": names, "platforms": list(platforms)}).encode()
-    return _MAGIC + struct.pack("<I", len(header)) + header + blob, names
+    header = json.dumps({
+        "names": names,
+        "platforms": list(platforms),
+        "nr_devices": int(exp.nr_devices),
+    }).encode()
+    return _MAGIC + struct.pack("<I", len(header)) + header + blob
 
 
 def save_exported_init(obj, path, *, platforms: Sequence[str] = ("tpu", "cpu")) -> List[str]:
@@ -79,10 +97,46 @@ def save_exported_init(obj, path, *, platforms: Sequence[str] = ("tpu", "cpu")) 
     return names
 
 
+def export_sharded_init(
+    obj: Union[torch.nn.Module, Dict[str, torch.Tensor]],
+    *,
+    mesh,
+    plan=None,
+    platforms: Sequence[str] = ("tpu",),
+) -> Tuple[bytes, List[str]]:
+    """The full login-host artifact: lower the init program SHARDED over
+    ``mesh`` per ``plan`` (the same plan→NamedSharding plumbing live
+    materialization uses), cross-lowered for ``platforms``, serialized.
+
+    The mesh's devices only fix the program's logical device COUNT —
+    export on a virtual CPU mesh of the pod's size (e.g. 64 devices for
+    a v5p-64) from a host with no accelerator, ship the payload, and the
+    pod runs the exact 64-way program with zero retracing or Python-side
+    model code.  Same container format as :func:`export_init`
+    (:func:`load_exported_init` reads both; running the program needs a
+    matching device count)."""
+    from jax import export as jax_export
+
+    from .materialize import _init_and_shardings
+
+    fakes = _named_fakes(obj)
+    names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+    jitted = jax.jit(init_fn, out_shardings=out_shardings)
+    exp = jax_export.export(jitted, platforms=list(platforms))(
+        jax.random.PRNGKey(0)
+    )
+    return _wrap_payload(exp, names, platforms), names
+
+
 def load_exported_init(path) -> Tuple[Callable[..., Tuple[jax.Array, ...]], List[str]]:
     """Load a saved export: ``(run, names)`` with ``run(key) -> tuple`` of
     arrays matching ``names``.  Executes on the current default platform
-    (must be one the program was exported for)."""
+    (must be one the program was exported for).
+
+    Sharded exports run too: an n-device program must be INVOKED from an
+    n-device context, so ``run`` wraps the call in a jit whose key input
+    is replicated over the first n local devices — a host with fewer
+    devices gets a friendly error here, not an XLA one mid-call."""
     from jax import export as jax_export
 
     with open(path, "rb") as f:
@@ -96,6 +150,7 @@ def load_exported_init(path) -> Tuple[Callable[..., Tuple[jax.Array, ...]], List
         header = json.loads(data[12 : 12 + hlen].decode())
         names = header["names"]
         platforms = header.get("platforms", [])
+        nr_devices = int(header.get("nr_devices", 1))
     except ValueError:
         raise
     except Exception as e:
@@ -109,5 +164,23 @@ def load_exported_init(path) -> Tuple[Callable[..., Tuple[jax.Array, ...]], List
             f"current default backend is {backend!r}. Re-export with "
             f"platforms=(..., {backend!r}) or run on a matching device."
         )
+    local = len(jax.devices())
+    if nr_devices > local:
+        raise ValueError(
+            f"`{path}` is a {nr_devices}-device sharded program; this host "
+            f"exposes only {local} device(s). Run it on a slice with at "
+            f"least {nr_devices} devices (or re-export over a smaller mesh)."
+        )
     exp = jax_export.deserialize(data[12 + hlen :])
-    return exp.call, names
+    if exp.nr_devices <= 1:
+        return exp.call, names
+    import numpy as _np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    run_mesh = Mesh(
+        _np.array(jax.devices()[: exp.nr_devices]), ("_tdx_export",)
+    )
+    run = jax.jit(
+        exp.call, in_shardings=NamedSharding(run_mesh, PartitionSpec())
+    )
+    return run, names
