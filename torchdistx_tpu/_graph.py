@@ -31,6 +31,7 @@ compile the same graph into an XLA program with sharded outputs.
 from __future__ import annotations
 
 import contextlib
+import gc
 import threading
 import weakref
 from dataclasses import dataclass
@@ -54,6 +55,40 @@ from . import _native
 CONTEXT_KEY = "deferred_init"
 
 _op_counter = itertools.count()
+
+
+# GC pause refcount: recording and replay allocate thousands of cyclic
+# node/op/trace objects that survive their region, so Python's
+# generational collector rescans them repeatedly for nothing (~40% of a
+# 70B record's wall time, measured).  gc.disable() is process-GLOBAL
+# while regions are per-thread, so concurrent/nested regions share one
+# counter — collection resumes when the LAST region exits, and only if
+# this module was the one that disabled it.
+_gc_pause_lock = threading.Lock()
+_gc_pause_depth = 0
+_gc_disabled_by_us = False
+
+
+@contextlib.contextmanager
+def gc_paused():
+    """Pause cyclic GC for an allocation-heavy region (recording, eager
+    replay, bridge interpretation); exception-safe, re-entrant, and
+    thread-shared.  Allocation-triggered collections resume at exit and
+    reap the region's actual garbage then."""
+    global _gc_pause_depth, _gc_disabled_by_us
+    with _gc_pause_lock:
+        _gc_pause_depth += 1
+        if _gc_pause_depth == 1 and gc.isenabled():
+            gc.disable()
+            _gc_disabled_by_us = True
+    try:
+        yield
+    finally:
+        with _gc_pause_lock:
+            _gc_pause_depth -= 1
+            if _gc_pause_depth == 0 and _gc_disabled_by_us:
+                _gc_disabled_by_us = False
+                gc.enable()
 
 
 def _next_op_nr() -> int:

@@ -26,7 +26,6 @@ compiles the same recording into an XLA program with GSPMD shardings.
 from __future__ import annotations
 
 import contextlib
-import gc
 import threading
 from typing import Any, Callable, Iterator, Optional
 
@@ -161,46 +160,14 @@ def no_deferred_init() -> Iterator[None]:
         _tls.suspended = prev
 
 
-# GC pause refcount: gc.disable() is process-GLOBAL while recording
-# regions are per-thread, so concurrent/nested regions share one counter
-# — collection resumes only when the LAST region exits, and only if this
-# module was the one that disabled it.
-_gc_pause_lock = threading.Lock()
-_gc_pause_depth = 0
-_gc_disabled_by_us = False
-
-
-@contextlib.contextmanager
-def _gc_paused() -> Iterator[None]:
-    """Recording allocates thousands of cyclic node/op objects that all
-    survive the region — Python's generational GC scans them over and
-    over for nothing (~40% of the 70B record wall time, measured).
-    Pause collection for the region; allocation-triggered collections
-    resume at exit and reap the region's actual garbage then."""
-    global _gc_pause_depth, _gc_disabled_by_us
-    with _gc_pause_lock:
-        _gc_pause_depth += 1
-        if _gc_pause_depth == 1 and gc.isenabled():
-            gc.disable()
-            _gc_disabled_by_us = True
-    try:
-        yield
-    finally:
-        with _gc_pause_lock:
-            _gc_pause_depth -= 1
-            if _gc_pause_depth == 0 and _gc_disabled_by_us:
-                _gc_disabled_by_us = False
-                gc.enable()
-
-
 @contextlib.contextmanager
 def _deferred(enabled: bool = True) -> Iterator[None]:
     if not enabled:
         yield
         return
     # The with-block ordering keeps the GC restore exception-safe: even
-    # an enable_deferred_init failure unwinds through _gc_paused.
-    with _gc_paused():
+    # an enable_deferred_init failure unwinds through gc_paused.
+    with _graph.gc_paused():
         enable_deferred_init(True)
         try:
             yield
@@ -307,25 +274,39 @@ def materialize_module(
     otherwise replay the whole session's dead draws out of order.
     """
     if _memo is None:
-        _memo = {}
-        # Pre-replay the union call stack in global chronological order so
-        # RNG consumption matches eager construction bitwise (see
-        # _graph.materialize_many).
-        fakes = []
-        def collect(mod):
-            if check_fn is not None and not check_fn(mod):
-                return
-            for child in mod.children():
-                collect(child)
-            if not buffers_only:
-                fakes.extend(t for t in mod._parameters.values() if t is not None and is_fake(t))
-            fakes.extend(t for t in mod._buffers.values() if t is not None and is_fake(t))
-        collect(module)
-        if replay_dead_rng is None:
-            replay_dead_rng = check_fn is None and not buffers_only
-        _graph.materialize_many(
-            fakes, target, include_session_rng=replay_dead_rng
-        )
+        # Outermost call: pre-replay the union call stack in global
+        # chronological order so RNG consumption matches eager
+        # construction bitwise (_graph.materialize_many), then recurse
+        # with the shared memo — all under one GC pause (replay allocates
+        # like recording does; see _graph.gc_paused).
+        with _graph.gc_paused():
+            fakes = []
+
+            def collect(mod):
+                if check_fn is not None and not check_fn(mod):
+                    return
+                for child in mod.children():
+                    collect(child)
+                if not buffers_only:
+                    fakes.extend(
+                        t for t in mod._parameters.values()
+                        if t is not None and is_fake(t)
+                    )
+                fakes.extend(
+                    t for t in mod._buffers.values()
+                    if t is not None and is_fake(t)
+                )
+
+            collect(module)
+            if replay_dead_rng is None:
+                replay_dead_rng = check_fn is None and not buffers_only
+            _graph.materialize_many(
+                fakes, target, include_session_rng=replay_dead_rng
+            )
+            return materialize_module(
+                module, buffers_only=buffers_only, check_fn=check_fn,
+                target=target, replay_dead_rng=replay_dead_rng, _memo={},
+            )
     if check_fn is not None and not check_fn(module):
         return module
 

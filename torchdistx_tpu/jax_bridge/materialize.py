@@ -17,6 +17,7 @@ import jax
 import torch
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .._graph import gc_paused
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
 from .compile import build_init_fn
@@ -196,11 +197,14 @@ def materialize_params_jax(
     RoPE ``inv_freq`` / batchnorm running stats must stay full precision
     under a bf16 param policy.
     """
-    names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
-    if param_dtype is not None:
-        mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
-        init_fn = _cast_outputs(init_fn, param_dtype, mask)
-    values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
+    # Tracing/interpreting the graph allocates like recording does
+    # (Box/lens objects, jaxpr eqns); same GC pause, same rationale.
+    with gc_paused():
+        names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+        if param_dtype is not None:
+            mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+            init_fn = _cast_outputs(init_fn, param_dtype, mask)
+        values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
     return dict(zip(names, values))
 
 
