@@ -122,8 +122,48 @@ def _cases():
         # guards); the baseline families below stay unaffected.
         newer = {}
 
+    from transformers import (
+        GPTJConfig,
+        GPTJModel,
+        Wav2Vec2Config,
+        Wav2Vec2Model,
+    )
+
+    stress = {
+        # weight_norm parametrization + grouped conv + the legacy
+        # torch.Tensor(n) ctor (whose C-side __new__ returns an
+        # already-built fake that Python then re-__init__s)
+        "wav2vec2": (
+            Wav2Vec2Model,
+            Wav2Vec2Config(
+                hidden_size=64, num_hidden_layers=2, num_attention_heads=2,
+                intermediate_size=128, conv_dim=(32, 32), conv_kernel=(3, 3),
+                conv_stride=(2, 2), num_feat_extract_layers=2,
+                num_conv_pos_embeddings=16, num_conv_pos_embedding_groups=4,
+                vocab_size=64,
+            ),
+        ),
+        "gptj": (
+            GPTJModel,
+            GPTJConfig(n_embd=64, n_layer=2, n_head=4, vocab_size=256,
+                       rotary_dim=16),
+        ),
+    }
+    try:
+        from transformers import MambaConfig, MambaModel
+
+        # SSM family: einsum-parameterized mixer, expm1/softplus dt init
+        stress["mamba"] = (
+            MambaModel,
+            MambaConfig(hidden_size=64, num_hidden_layers=2, state_size=8,
+                        vocab_size=256),
+        )
+    except ImportError:
+        pass
+
     return {
         **newer,
+        **stress,
         "gpt2": (GPT2LMHeadModel, GPT2Config(n_layer=2, n_embd=64, n_head=4, vocab_size=256)),
         "bert": (
             BertModel,
@@ -213,7 +253,7 @@ def test_eager_parity_llama():
 
 EXTRA_FAMILIES = [
     "bert", "vit", "whisper", "gpt_neox", "falcon", "clip", "gemma",
-    "qwen2", "phi", "opt", "bloom",
+    "qwen2", "phi", "opt", "bloom", "wav2vec2", "gptj", "mamba",
 ]
 
 

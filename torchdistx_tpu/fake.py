@@ -160,8 +160,23 @@ class FakeTensor(torch.Tensor):
         )
         return r
 
-    def __init__(self, meta: torch.Tensor, device: torch.device, requires_grad: bool = False):
+    def __init__(self, meta=None, device=None, requires_grad: bool = False):
         super().__init__()
+        if hasattr(self, "_meta"):
+            # Re-init of a complete fake, REGARDLESS of the args: the
+            # legacy ctor ``torch.Tensor(n)`` (HF wav2vec2's
+            # masked_spec_embed does this) builds its storage through a
+            # dispatched ``empty`` that already returned a fully-formed
+            # fake out of ``Tensor.__new__`` — Python's type protocol
+            # then re-invokes ``__init__(fake, <ctor args>)``.  Ignore
+            # it; overwriting state here would drop the recorded
+            # context.  (The reference handles the same entry by
+            # detecting internal_new_from_data, deferred_init.cc:776-785.)
+            return
+        if not (isinstance(meta, torch.Tensor) and meta.device.type == "meta"):
+            raise TypeError(
+                "FakeTensor(meta, device): `meta` must be a meta tensor"
+            )
         self._meta = meta
         self._fake_device = torch.device(device)
         self._fake_contexts = {}

@@ -434,7 +434,9 @@ for name, fn in {
     "aten.rsqrt.default": lambda x: _div(1.0, jnp.sqrt(x)),
     "aten.abs.default": jnp.abs,
     "aten.exp.default": jnp.exp,
+    "aten.expm1.default": jnp.expm1,  # Mamba's softplus-based dt init
     "aten.log.default": jnp.log,
+    "aten.log1p.default": jnp.log1p,
     "aten.erf.default": jax.scipy.special.erf,
     "aten.erfinv.default": jax.scipy.special.erfinv,
     "aten.tanh.default": jnp.tanh,
