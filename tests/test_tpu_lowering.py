@@ -63,6 +63,21 @@ def test_bench_shape_lowers_for_tpu():
     assert _export(fwd, q, q, q).mlir_module()
 
 
+def test_graft_entry_shape_lowers_for_tpu():
+    # The driver's single-chip compile check runs the flagship TINY
+    # Llama THROUGH the flash kernel (__graft_entry__.entry): guard its
+    # exact shape class — f32, D=16, S=32, GQA 4/2, blocks clamped to
+    # 32x32 — so a tiling assumption valid only at D=64 cannot pass CI
+    # and then fail the driver's on-hardware Mosaic compile.
+    q = jnp.zeros((2, 32, 4, 16), jnp.float32)
+    k = jnp.zeros((2, 32, 2, 16), jnp.float32)
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False)
+
+    assert _export(fwd, q, k, k).mlir_module()
+
+
 @pytest.mark.parametrize("bias_heads", [H, 1])
 def test_flash_bias_and_segments_lower_for_tpu(bias_heads):
     # The full operand surface in one program: additive bias (incl. the
