@@ -114,6 +114,49 @@ class _Tied(nn.Module):
         self.head.weight = self.emb.weight  # weight tying
 
 
+class _LegacyCtor(nn.Module):
+    """HF wav2vec2's masked_spec_embed idiom: the legacy torch.Tensor(n)
+    ctor (whose C-side __new__ returns an already-built fake that Python
+    then re-__init__s) filled in place."""
+
+    def __init__(self, rng):
+        super().__init__()
+        n = rng.choice([4, 8])
+        self.embed = nn.Parameter(torch.Tensor(n).uniform_())
+
+
+class _WeightNorm(nn.Module):
+    """weight_norm parametrization (wav2vec2's conv pos-embedding): init
+    computes (g, v) from the wrapped weight through norm/div chains."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.utils.parametrizations.weight_norm(
+            nn.Conv1d(4, 4, 3)
+        )
+
+
+class _GeometrySurgery(nn.Module):
+    """Round-3 idioms: geometry-changing in-place ops and
+    metadata-changing .data on params (re-wrap semantics)."""
+
+    def __init__(self, rng):
+        super().__init__()
+        style = rng.randrange(3)
+        if style == 0:
+            w = torch.full((4, 6), 1.0)
+            w.t_()
+            self.w = nn.Parameter(w)
+        elif style == 1:
+            p = nn.Parameter(torch.zeros(3, 3))
+            p.data = torch.full((2, 5), 2.0)
+            self.w = p
+        else:
+            w = torch.arange(24.0).reshape(2, 3, 4)
+            w.resize_(4, 5)
+            self.w = nn.Parameter(w)
+
+
 def _random_tree(rng, depth=0):
     roll = rng.random()
     if depth >= 2 or roll < 0.45:
@@ -121,6 +164,12 @@ def _random_tree(rng, depth=0):
             return _Tied()
         if roll < 0.2:
             return _CustomInit(rng)
+        if roll < 0.26:
+            return _LegacyCtor(rng)
+        if roll < 0.3:
+            return _WeightNorm()
+        if roll < 0.36:
+            return _GeometrySurgery(rng)
         return rng.choice(_LEAVES)(rng)
     n = rng.randint(2, 3)
     children = [_random_tree(rng, depth + 1) for _ in range(n)]
