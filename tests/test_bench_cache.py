@@ -1,0 +1,124 @@
+"""Unit tests for bench.py's hardware-cache machinery.
+
+The promotion path (a wedged-tunnel run carrying the last real-chip
+numbers, age-labeled) has to work the FIRST time hardware ever appears —
+it cannot wait to be debugged against a live tunnel.  These tests pin
+the pure pieces: which cache entries qualify as hardware, how flash
+results merge under the phase key schemes, and the warm-stamp entry
+filter.
+"""
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "BCACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(mod, "CACHE_DIR", str(tmp_path / "jax"))
+    yield mod
+    sys.modules.pop("bench", None)
+
+
+def _write(bench, name, platform, result, ts=None):
+    p = Path(bench.BCACHE_DIR)
+    p.mkdir(exist_ok=True)
+    with open(p / f"{name}.json", "w") as f:
+        json.dump({"ts": ts or time.time(), "platform": platform,
+                   "result": result}, f)
+
+
+class TestReadHwCache:
+    def test_accepts_accelerator_stamp(self, bench):
+        _write(bench, "gpt2_ours", "axon", {"t": 3.1, "rss_mb": 1000.0})
+        got = bench._read_hw_cache("gpt2_ours")
+        assert got is not None and got["result"]["t"] == 3.1
+
+    @pytest.mark.parametrize("platform", ["cpu", "default", None])
+    def test_rejects_non_hardware_stamps(self, bench, platform):
+        # "default" is the legacy env-based stamp a silently-failed
+        # plugin could have earned on CPU; None is unstamped.
+        _write(bench, "gpt2_ours", platform, {"t": 3.1})
+        assert bench._read_hw_cache("gpt2_ours") is None
+
+    def test_rejects_entries_without_a_measurement(self, bench):
+        _write(bench, "gpt2_ours", "axon", {"rss_mb": 1000.0})
+        assert bench._read_hw_cache("gpt2_ours") is None
+
+    def test_flash_entries_qualify_via_flash_ms(self, bench):
+        _write(bench, "flash", "axon", {"flash_ms": 1.1, "speedup": 4.0})
+        assert bench._read_hw_cache("flash") is not None
+
+    def test_missing_or_corrupt_is_none(self, bench, tmp_path):
+        assert bench._read_hw_cache("nope") is None
+        (tmp_path / "bad.json").write_text("{notjson")
+        assert bench._read_hw_cache("bad") is None
+
+
+class TestMergeFlash:
+    def test_fwd_phase_key_scheme(self, bench):
+        out = {}
+        bench._merge_flash_result(out, "flash", {
+            "flash_ms": 1.0, "ref_ms": 4.0, "flash_tflops": 50.0,
+            "speedup": 4.0, "mfu": 0.25, "device_kind": "TPU v5e",
+        })
+        assert out["flash_ms"] == 1.0
+        assert out["ref_ms"] == 4.0            # ref keys unprefixed
+        assert out["flash_speedup"] == 4.0     # bare keys gain flash_
+        assert out["flash_mfu"] == 0.25
+        assert out["flash_device_kind"] == "TPU v5e"
+
+    def test_flavor_phase_key_scheme(self, bench):
+        out = {}
+        bench._merge_flash_result(out, "flash_bwd", {
+            "flash_ms": 2.0, "ref_ms": 9.0, "speedup": 4.5, "mfu": 0.3,
+        })
+        assert out["flash_bwd_ms"] == 2.0      # flash_ stutter collapsed
+        assert out["flash_bwd_ref_ms"] == 9.0
+        assert out["flash_bwd_speedup"] == 4.5
+        assert out["flash_bwd_mfu"] == 0.3
+
+    def test_cached_merge_carries_age(self, bench):
+        _write(bench, "flash", "axon", {"flash_ms": 1.0, "speedup": 4.0},
+               ts=time.time() - 3600)
+        out = {}
+        bench._merge_cached_flash(out, "flash")
+        assert out["flash_ms"] == 1.0
+        assert 3500 <= out["flash_stale_s"] <= 3700
+
+    def test_cached_merge_skips_cpu_entries(self, bench):
+        _write(bench, "flash", "cpu", {"flash_ms": 1.0})
+        out = {}
+        bench._merge_cached_flash(out, "flash")
+        assert out == {}
+
+
+class TestWarmEntryFilter:
+    def test_only_substantial_entries_count(self, bench, tmp_path):
+        jax_dir = tmp_path / "jax"
+        jax_dir.mkdir()
+        (jax_dir / "tiny").write_bytes(b"x" * 100)
+        assert bench._cache_entries() == set()
+        (jax_dir / "big").write_bytes(b"x" * 40000)
+        assert bench._cache_entries() == {"big"}
+
+
+class TestPeakTable:
+    def test_known_kinds(self, bench):
+        assert bench._peak_tflops("TPU v5e") == 197.0
+        assert bench._peak_tflops("TPU v5 lite") == 197.0
+        assert bench._peak_tflops("TPU v4") == 275.0
+
+    def test_unknown_kind_omits_mfu(self, bench):
+        assert bench._peak_tflops("cpu") is None
