@@ -1,0 +1,51 @@
+"""tools/capture_hw_bench.py must succeed the FIRST time a tunnel window
+appears — pin its success/failure accounting with a stubbed phase
+runner (no accelerator needed)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def capture(monkeypatch):
+    # capture_hw_bench imports bench (repo root); make both importable.
+    monkeypatch.syspath_prepend(str(REPO))
+    spec = importlib.util.spec_from_file_location(
+        "capture_hw_bench", REPO / "tools" / "capture_hw_bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop("bench", None)
+
+
+def test_success_when_headline_pair_lands_on_hardware(capture, monkeypatch, capsys):
+    def fake_run(name, timeout):
+        if name.startswith("gpt2"):
+            return {"t": 1.0, "rss_mb": 10.0, "_backend": "axon"}
+        return {"error": "tunnel dropped mid-phase"}
+
+    monkeypatch.setattr(capture.bench, "_run_phase", fake_run)
+    assert capture.main() == 0
+    out = capsys.readouterr().out
+    assert '"gpt2_ours"' in out and "axon" in out
+
+
+def test_failure_when_headline_fell_back_to_cpu(capture, monkeypatch):
+    def fake_run(name, timeout):
+        return {"t": 1.0, "_backend": "cpu"}  # silently degraded plugin
+
+    monkeypatch.setattr(capture.bench, "_run_phase", fake_run)
+    assert capture.main() == 1
+
+
+def test_failure_when_every_phase_errors(capture, monkeypatch):
+    monkeypatch.setattr(
+        capture.bench, "_run_phase", lambda name, timeout: {"error": "boom"}
+    )
+    assert capture.main() == 1
