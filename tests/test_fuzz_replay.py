@@ -438,11 +438,13 @@ def _f64_tainted(steps):
     taint: list = []   # per pool index: value is f64-derived
     group: list = []   # storage-alias-group id per pool index
     obj: list = []     # python-object id per pool index
+    dty: list = []     # shadow dtype name per pool index
 
-    def new(g=None, t=False, o=None):
+    def new(g=None, t=False, o=None, d="float32"):
         group.append(g if g is not None else len(group))
         taint.append(t)
         obj.append(o if o is not None else len(obj))
+        dty.append(d)
 
     def taint_group(g):
         for i, gi in enumerate(group):
@@ -459,34 +461,47 @@ def _f64_tainted(steps):
             _, i, op, arg = step
             n_out = arg if op == "chunk" else 1
             for _ in range(n_out):
-                new(group[i], taint[i])
+                new(group[i], taint[i], d=dty[i])
         elif kind == "data_read":
-            new(group[step[1]], taint[step[1]])
+            new(group[step[1]], taint[step[1]], d=dty[step[1]])
         elif kind in ("inplace_scalar", "uniform_", "normal_", "geom_inplace"):
             i = step[1]
-            new(group[i], taint[i], obj[i])  # same object back in the pool
+            new(group[i], taint[i], obj[i], dty[i])  # same object again
         elif kind == "inplace_binary":
             _, i, j, op = step
             if taint[j] and not taint[i]:
                 taint_group(group[i])
-            new(group[i], taint[i], obj[i])
+            new(group[i], taint[i], obj[i], dty[i])
         elif kind in ("outofplace", "clone", "deepcopy"):
-            new(t=taint[step[1]])
+            i = step[1]
+            new(t=taint[i], d=dty[i])
         elif kind == "cat":
             _, i, j = step
-            new(t=taint[i] or taint[j])
+            promo = "float64" if "float64" in (dty[i], dty[j]) else (
+                "float32" if "float32" in (dty[i], dty[j]) else dty[i]
+            )
+            new(t=taint[i] or taint[j], d=promo)
         elif kind == "cast":
             _, i, dt = step
-            new(t=taint[i] or "float64" in str(dt))
+            tgt = str(dt).split(".")[-1]
+            if tgt == dty[i]:
+                # .to() with matching dtype (and device) returns SELF:
+                # the "cast" result IS the source python object, so it
+                # shares object identity, group, and future set_data
+                # rebinds (soak find, seed 9029030).
+                new(group[i], taint[i], obj[i], dty[i])
+            else:
+                new(t=taint[i] or tgt == "float64", d=tgt)
         elif kind == "set_data":
             _, i, j = step
             # pool[i] rebinds to pool[j]'s storage (no data is written).
             # The rebound thing is the python OBJECT — every pool index
-            # occupied by it re-groups, not just index i.
+            # occupied by it re-groups (and takes the donor's dtype),
+            # not just index i.
             for k in range(len(obj)):
                 if obj[k] == obj[i]:
-                    group[k], taint[k] = group[j], taint[j]
-            new(group[j], taint[j], obj[i])
+                    group[k], taint[k], dty[k] = group[j], taint[j], dty[j]
+            new(group[j], taint[j], obj[i], dty[j])
         else:  # pragma: no cover - keep in sync with _gen_program
             raise AssertionError(f"untracked step kind {kind!r}")
     return {i for i, t in enumerate(taint) if t}
@@ -544,12 +559,18 @@ def _jax_bridge_oracle(seed, *, allow_data_ops, allow_geom_ops=False,
             assert np.array_equal(e, j), msg
 
 
-@pytest.mark.parametrize("seed", list(range(3200, 3200 + 16)) + [3001006])
+@pytest.mark.parametrize(
+    "seed", list(range(3200, 3200 + 16)) + [3001006, 9029030]
+)
 def test_jax_bridge_geometry_ops_match_eager(seed):
     # 3001006: geom-soak find — a dtype-changing set_data donor reaches
     # other pool indices of the same python object (in-place ops append
     # the same object); the f64-taint tracker must follow object
     # identity, not just the assigned index.
+    # 9029030: second soak find, same family — .to() with a MATCHING
+    # dtype returns SELF, so a "cast" result shares object identity and
+    # later set_data rebinds; the tracker models shadow dtypes to apply
+    # .to's return-self rule.
     # Geometry-changing in-place ops and metadata-changing .data through
     # the Box/lens interpreter: t_/transpose_/squeeze_/unsqueeze_ are
     # view lenses over the input box; resize_ is a storage-relative lens
