@@ -589,7 +589,8 @@ def test_jax_bridge_data_ops_match_eager(seed):
 
 
 @pytest.mark.parametrize(
-    "seed", [202931, 204251, 205955, 206495, 209755, 212183, 1220203]
+    "seed",
+    [202931, 204251, 205955, 206495, 209755, 212183, 1220203, 12013093],
 )
 def test_soak_regression_jax_bridge_exact_division(seed):
     # Round-2 soak regressions: XLA's algebraic simplifier (1) turns
@@ -599,6 +600,9 @@ def test_soak_regression_jax_bridge_exact_division(seed):
     # _div hides the divisor AND its result behind optimization_barrier.
     # (Programs casting through f64 additionally exercise the documented
     # f32-tolerance path.)
+    # 12013093 (round-3 soak): the simplifier also FACTORS
+    # add(mul(x, d), d) → mul(d, x+1) — one rounding where torch rounds
+    # twice; every binop result is now opaque like _div's.
     _jax_bridge_oracle(seed, allow_data_ops=True)
 
 

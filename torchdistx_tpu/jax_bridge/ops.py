@@ -323,7 +323,11 @@ def _binop_inplace(fn):
     def impl(ctx, cur, other, *rest, **kw):
         alpha = kw.get("alpha", rest[0] if rest else 1)
         other, alpha = _scaled_operand(other, alpha)
-        return fn(cur, other, alpha).astype(cur.dtype)
+        # The RESULT is opaque too (like _div's): an operand barrier
+        # hides the producer but not value identity, so the simplifier
+        # could still factor add(mul(x, B), B) → mul(B, x+1) — one
+        # rounding where torch rounds twice (soak seed 12013093).
+        return _opaque(fn(cur, other, alpha)).astype(cur.dtype)
 
     return impl
 
@@ -401,7 +405,8 @@ def _binop_pure(fn):
     def impl(ctx, a, b, *rest, **kw):
         alpha = kw.get("alpha", rest[0] if rest else 1)
         b, alpha = _scaled_operand(b, alpha)
-        return fn(jnp.asarray(a), b, alpha)
+        # Result opaque like _binop_inplace's — see the note there.
+        return _opaque(fn(jnp.asarray(a), b, alpha))
 
     return impl
 
