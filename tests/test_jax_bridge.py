@@ -523,6 +523,43 @@ class TestLowerInitModule:
         assert "stablehlo" in lowered.as_text() or "func.func" in lowered.as_text()
 
 
+class TestLLVMContraction:
+    """Soak seed 12013093: XLA CPU codegen contracts fmul+fadd into a
+    single-rounded FMA — torch's two eager kernels round twice.  The fix
+    (`ops._kernel_boundary`) hides every mul behind a `conditional` whose
+    branches compile to separate LLVM functions.  These tests pin both
+    the numbers AND the structure, so a future XLA that starts folding
+    barrier-predicated conditionals fails loudly here rather than
+    silently un-fixing the exactness policy."""
+
+    @staticmethod
+    def _make():
+        w = torch.arange(12, dtype=torch.float32).reshape(2, 6)
+        t = w.div(3.0)
+        w = w.clone()
+        w.mul_(t)
+        w.add_(t)
+        return w
+
+    def test_mul_add_double_rounds(self):
+        expected = self._make()  # real torch eager: two roundings
+        fake = deferred_init(self._make)
+        arr = materialize_tensor_jax(fake)
+        assert np.array_equal(np.asarray(arr), expected.numpy())
+
+    def test_mul_survives_llvm_contraction(self):
+        fake = deferred_init(self._make)
+        fn = build_init_fn([fake])
+        key = jax.random.PRNGKey(0)
+        txt = jax.jit(fn).lower(key).compile().as_text()
+        # The POST-optimization HLO must still carry the mul's conditional:
+        # if any pass inlined it, contraction is back on the table.
+        assert " conditional(" in txt, (
+            "the _kernel_boundary conditional was optimized away — LLVM "
+            "can contract fmul+fadd again (soak seed 12013093)"
+        )
+
+
 class TestMultiOutputViews:
     def test_split_chunk_alias_lowering(self):
         # aten.split is ONE node with several aliasing view outputs; each

@@ -311,6 +311,31 @@ def _opaque(x):
     return jax.lax.optimization_barrier(jnp.asarray(x))
 
 
+def _kernel_boundary(compute):
+    """Run ``compute()`` behind a conditional call boundary so LLVM cannot
+    contract its final multiply into a consumer add/sub.
+
+    XLA CPU emits float ops with the ``contract`` fast-math flag, so a
+    fused loop containing ``fmul`` + ``fadd`` becomes a single-rounded
+    ``fmuladd`` — where torch's two eager kernels round twice (soak seed
+    12013093: torch ``44.000004`` vs fused ``44.0``).  ``_opaque``'s
+    ``optimization_barrier`` does not help: the barrier expander runs
+    before CPU fusion, so codegen never sees it.  A ``conditional``'s
+    branches are emitted as separate LLVM functions, which contraction
+    cannot cross.  The predicate is barrier-opaque truth, so the
+    conditional folds neither at trace time nor in HLO simplification
+    (which runs before barrier expansion); the false branch differs
+    structurally (zeros) so identical-branch merging can never inline
+    it.  tests/test_jax_bridge.py::test_mul_survives_llvm_contraction
+    asserts the conditional survives into the optimized HLO."""
+    aval = jax.eval_shape(compute)
+    return jax.lax.cond(
+        jax.lax.optimization_barrier(jnp.bool_(True)),
+        compute,
+        lambda: jnp.zeros(aval.shape, aval.dtype),
+    )
+
+
 def _scaled_operand(b, alpha):
     """torch applies ``alpha`` to a SCALAR operand in C++ Scalar (double)
     math before the kernel; mirror that, then make the result opaque."""
@@ -336,8 +361,14 @@ TABLE["aten.add_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a + al *
 TABLE["aten.add_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a + al * b))
 TABLE["aten.sub_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a - al * b))
 TABLE["aten.sub_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a - al * b))
-TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
-TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a * b))
+def _mul(a, b, al):
+    # Inside _kernel_boundary: a bare fmul result is the one thing a
+    # downstream fadd/fsub can contract into an FMA (see _kernel_boundary).
+    return _kernel_boundary(lambda: a * b)
+
+
+TABLE["aten.mul_.Tensor"] = ("inplace", _binop_inplace(_mul))
+TABLE["aten.mul_.Scalar"] = ("inplace", _binop_inplace(_mul))
 def _div(a, b, rounding_mode=None):
     # Divisor behind _opaque: x / c would strength-reduce into x * (1/c).
     # The RESULT is opaque too: XLA merges runtime divide chains —
@@ -415,8 +446,8 @@ TABLE["aten.add.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a + al * b))
 TABLE["aten.add.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a + al * b))
 TABLE["aten.sub.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
 TABLE["aten.sub.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a - al * b))
-TABLE["aten.mul.Tensor"] = ("pure", _binop_pure(lambda a, b, al: a * b))
-TABLE["aten.mul.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a * b))
+TABLE["aten.mul.Tensor"] = ("pure", _binop_pure(_mul))
+TABLE["aten.mul.Scalar"] = ("pure", _binop_pure(_mul))
 def _div_pure(ctx, a, b, *rest, **kw):
     mode = kw.get("rounding_mode", rest[0] if rest else None)
     return _div(jnp.asarray(a), jnp.asarray(b), mode)
@@ -426,10 +457,17 @@ TABLE["aten.div.Tensor"] = ("pure", _div_pure)
 TABLE["aten.div.Scalar"] = ("pure", _div_pure)
 TABLE["aten.div.Tensor_mode"] = ("pure", _div_pure)
 TABLE["aten.div.Scalar_mode"] = ("pure", _div_pure)
-TABLE["aten.pow.Tensor_Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
-TABLE["aten.pow.Scalar"] = ("pure", _binop_pure(lambda a, b, al: a**b))
-TABLE["aten.pow.Tensor_Tensor"] = ("pure", _binop_pure(lambda a, b, al: a**b))
-TABLE["aten.pow_.Scalar"] = ("inplace", _binop_inplace(lambda a, b, al: a**b))
+def _pow(a, b, al):
+    # x**2 lowers to integer_pow → a trailing fmul: same contraction
+    # hazard as aten.mul, same containment.
+    return _kernel_boundary(lambda: a**b)
+
+
+TABLE["aten.pow.Tensor_Scalar"] = ("pure", _binop_pure(_pow))
+TABLE["aten.pow.Scalar"] = ("pure", _binop_pure(_pow))
+TABLE["aten.pow.Tensor_Tensor"] = ("pure", _binop_pure(_pow))
+TABLE["aten.pow_.Scalar"] = ("inplace", _binop_inplace(_pow))
+TABLE["aten.pow_.Tensor"] = ("inplace", _binop_inplace(_pow))
 
 for name, fn in {
     "aten.neg.default": lambda x: -x,
