@@ -76,3 +76,18 @@ def send_prev(x, axis: str):
     zeros."""
     n = lax.psum(1, axis)
     return lax.ppermute(x, axis, [(i + 1, i) for i in range(n - 1)])
+
+
+def ring_next(x, axis: str):
+    """Shift to the next device WITH wraparound (true ring): the
+    interleaved pipeline's chunk hand-offs cross the ``pp-1 -> 0`` edge
+    (global chunk ``k`` on device ``k % pp`` feeds ``k+1`` on
+    ``(k+1) % pp``), which :func:`send_next` deliberately drops."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def ring_prev(x, axis: str):
+    """Shift to the previous device WITH wraparound (true ring)."""
+    n = lax.psum(1, axis)
+    return lax.ppermute(x, axis, [((i + 1) % n, i) for i in range(n)])

@@ -24,6 +24,7 @@ from .pipeline import (
     _sum_aux,
     default_decomposition,
     pipeline_train_1f1b,
+    pipeline_train_interleaved,
     pipelined_decoder_apply,
     valid_next_token_mask,
 )
@@ -60,6 +61,7 @@ def make_train_step(
     pipeline_axis: str = "pp",
     pipeline_schedule: str = "gpipe",
     n_microbatches: int = 4,
+    n_chunks: int = 2,
     attn_fn=None,
     donate: bool = True,
 ):
@@ -123,10 +125,10 @@ def make_train_step(
         ce = lm_cross_entropy(logits, tokens, segment_ids)
         return ce + aux, (ce, aux)
 
-    if pipeline_schedule not in ("gpipe", "1f1b"):
+    if pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(
-            f"pipeline_schedule must be 'gpipe' or '1f1b', got "
-            f"{pipeline_schedule!r}"
+            f"pipeline_schedule must be 'gpipe', '1f1b' or 'interleaved', "
+            f"got {pipeline_schedule!r}"
         )
     if pipeline_schedule != "gpipe" and not pipeline:
         # Silently training the dense path while the caller believes
@@ -135,7 +137,7 @@ def make_train_step(
             f"pipeline_schedule={pipeline_schedule!r} requires "
             f"pipeline=True (got pipeline=False)."
         )
-    use_1f1b = pipeline and pipeline_schedule == "1f1b"
+    use_1f1b = pipeline and pipeline_schedule in ("1f1b", "interleaved")
     if use_1f1b and decomp is None:
         # Same stock-family fallback the GPipe path gets inside
         # pipelined_decoder_apply; custom families must export
@@ -145,10 +147,14 @@ def make_train_step(
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, tokens, segment_ids=None):
         if use_1f1b:
-            # The 1F1B schedule produces gradients directly (no
+            # The 1F1B schedules produce gradients directly (no
             # jax.grad over the schedule — backwards are interleaved
             # into it).
-            metrics, grads = pipeline_train_1f1b(
+            fused = (
+                pipeline_train_1f1b if pipeline_schedule == "1f1b"
+                else partial(pipeline_train_interleaved, n_chunks=n_chunks)
+            )
+            metrics, grads = fused(
                 cfg, state["params"], tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
                 attn_fn=attn_fn or default_attention,
