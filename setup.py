@@ -70,7 +70,13 @@ class build_py_with_native(build_py):
 
 setup(
     name="torchdistx_tpu",
-    version="0.1.0.dev0",
+    # Single source of truth for the version: the VERSION file (the
+    # reference keeps one consumed by scripts/set-version, VERSION:1).
+    # The conda recipe's duplicated pin is checked against it by
+    # packaging/conda/smoke.sh (`make packaging-smoke`).
+    version=(ROOT / "VERSION").read_text().strip(),
+    license="BSD-3-Clause",
+    license_files=["LICENSE"],
     description=(
         "TPU-native fake tensors and deferred module initialization: "
         "record init, materialize sharded into TPU HBM via XLA"
