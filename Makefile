@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke clean
+.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke docs clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -52,6 +52,16 @@ wheel:
 # needed; see packaging/conda/smoke.sh).
 packaging-smoke:
 	bash packaging/conda/smoke.sh
+
+# Render the markdown docs into a Sphinx site (docs/conf.py).  The dev
+# image ships no sphinx, so degrade to a skip locally; CI installs the
+# toolchain and fails loudly (.github/workflows/docs.yaml).
+docs:
+	@if python -c "import sphinx, myst_parser" 2>/dev/null; then \
+		python -m sphinx -b html docs docs/_build/html; \
+	else \
+		echo "docs build skipped: sphinx/myst-parser not installed (CI runs it)"; \
+	fi
 
 clean:
 	rm -rf csrc/build torchdistx_tpu/_lib

@@ -1,0 +1,28 @@
+"""Sphinx configuration for the rendered doc site.
+
+Reference parity: the reference ships Sphinx docs plus a publish
+workflow (reference docs/src/conf.py:1, .github/workflows/push_doc.yaml:1).
+This build's documentation is markdown-first (the files in this
+directory), so the Sphinx layer is thin: myst-parser renders the same
+markdown into a navigable site.  Built by ``make docs`` and CI
+(.github/workflows/docs.yaml); the dev image has no sphinx, so the
+local target degrades to a skip with a message.
+"""
+
+import pathlib
+
+project = "torchdistx_tpu"
+copyright = "2026, the torchdistx_tpu authors"
+author = "the torchdistx_tpu authors"
+release = (
+    pathlib.Path(__file__).resolve().parent.parent / "VERSION"
+).read_text().strip()
+
+extensions = ["myst_parser"]
+source_suffix = {".md": "markdown", ".rst": "restructuredtext"}
+master_doc = "index"
+exclude_patterns = ["_build"]
+
+html_theme = "furo"
+html_title = f"torchdistx_tpu {release}"
+myst_heading_anchors = 3
