@@ -78,7 +78,11 @@ def gc_paused():
     global _gc_pause_depth, _gc_disabled_by_us
     with _gc_pause_lock:
         _gc_pause_depth += 1
-        if _gc_pause_depth == 1 and gc.isenabled():
+        # Checked on EVERY entry, not just the 0->1 transition: if the
+        # outermost region found GC already off (flag stays False) and
+        # other code re-enabled it mid-region, a nested entry re-arms
+        # the pause instead of silently degrading (ADVICE r3).
+        if gc.isenabled():
             gc.disable()
             _gc_disabled_by_us = True
     try:
