@@ -346,6 +346,30 @@ def _env_ints(name: str, default: str, n: int):
     return vals
 
 
+def _first_fitting_blocks(bench_fn, mk_step, mk_flash, ladder):
+    """Measure the first (block_q, block_k) candidate that actually
+    compiles, walking ``ladder`` in preference order.
+
+    Mosaic rejects block configs whose operand tiles overrun the chip's
+    scoped vmem (v5e: 16MB — the [1024, 1024] bias flavor lost by 576K
+    in the round-4 hardware capture), and the budget varies by chip
+    generation, so a static table can't be trusted.  Returns
+    ``(seconds, (bq, bk), demoted)`` where ``demoted`` says a larger
+    candidate failed to fit; re-raises the last error if none fit."""
+    from torchdistx_tpu.ops.autotune import _is_vmem_error
+
+    last_err = None
+    for bq, bk in ladder:
+        try:
+            t = bench_fn(mk_step(mk_flash(block_q=bq, block_k=bk)))
+            return t, (bq, bk), last_err is not None
+        except Exception as e:
+            if not _is_vmem_error(e):
+                raise  # tunnel hiccups etc. must not masquerade as demotion
+            last_err = e
+    raise last_err
+
+
 def _flash_phase(mode: str) -> dict:
     """Shared runner for the flash kernel phases (one schema, one timing
     methodology, three workloads):
@@ -391,6 +415,13 @@ def _flash_phase(mode: str) -> dict:
     # costs a cold Mosaic compile through the tunnel).
     kind = jax.devices()[0].device_kind
     bq = bk = 1024
+    if mode == "bias":
+        # The f32 [bq, bk] bias tile is double-buffered into scoped vmem
+        # alongside q/k/v: at [1024, 1024] that overran v5e's 16MB scoped
+        # budget by 576K in the round-4 hardware capture.  [1024, 512]
+        # halves the bias tile; _first_fitting_blocks below still steps
+        # down further on chips with tighter vmem.
+        bq, bk = 1024, 512
     autotuned = False
     known = any(s in kind.lower() for s in ("v5 lite", "v5e", "v5litepod"))
     if jax.default_backend() != "cpu" and (
@@ -407,7 +438,6 @@ def _flash_phase(mode: str) -> dict:
             autotuned = True
         except Exception:
             pass  # defaults are sound on every kind tested so far
-    flash_attention = make_flash_attention(block_q=bq, block_k=bk)
     q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
@@ -480,7 +510,13 @@ def _flash_phase(mode: str) -> dict:
         t_hi = time.perf_counter() - t0
         return (t_hi - t_lo) / (n_hi - n_lo)
 
-    t_flash = bench(make_step(flash_attention))
+    ladder = [(bq, bk)] + [
+        c for c in ((1024, 512), (512, 512), (512, 256), (256, 256))
+        if c != (bq, bk)
+    ]
+    t_flash, (bq, bk), demoted = _first_fitting_blocks(
+        bench, make_step, make_flash_attention, ladder
+    )
     t_ref = bench(make_step(default_attention))
     peak = _peak_tflops(kind)
     out = {
@@ -492,6 +528,7 @@ def _flash_phase(mode: str) -> dict:
         "device_kind": kind,
         "blocks": [bq, bk],
         **({"autotuned": True} if autotuned else {}),
+        **({"vmem_demoted": True} if demoted else {}),
     }
     if peak is not None:
         # Achieved / peak dense-bf16 — the MFU the charter judges.

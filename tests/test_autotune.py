@@ -72,3 +72,81 @@ def test_empty_candidates_raise(cache_dir):
             batch=1, seq_len=8, heads=2, head_dim=16,
             candidates=(), use_cache=False,
         )
+
+
+def test_compile_failure_measures_as_inf():
+    # A candidate whose tiles overrun scoped vmem dies in Mosaic
+    # compilation (v5e: [1024,1024] + f32 bias tile, round-4 capture).
+    # _measure must report +inf — not propagate — so the survivors
+    # compete and tuning completes on any chip generation.
+    import jax.numpy as jnp
+
+    def boom(q, k, v):
+        raise RuntimeError("RESOURCE_EXHAUSTED: scoped vmem")
+
+    q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
+    assert autotune._measure(boom, q, k, v) == float("inf")
+
+
+def test_oom_candidate_loses_to_fitting_one(cache_dir, monkeypatch):
+    import importlib
+
+    # The package re-exports the FUNCTION under the same name; fetch
+    # the module itself, which is what the tuner imports from.
+    fa_mod = importlib.import_module("torchdistx_tpu.ops.flash_attention")
+    real = fa_mod.flash_attention
+
+    def gated(q, k, v, *a, block_q=None, block_k=None, **kw):
+        if block_q == 32:
+            raise RuntimeError("RESOURCE_EXHAUSTED: scoped vmem")
+        return real(q, k, v, *a, block_q=block_q, block_k=block_k, **kw)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", gated)
+    blocks = tune_flash_blocks(
+        batch=1, seq_len=32, heads=2, head_dim=16,
+        candidates=((32, 16), (16, 16)), use_cache=False,
+    )
+    assert blocks == (16, 16)
+
+
+def test_all_candidates_failing_returns_smallest(cache_dir, monkeypatch):
+    # Nothing compiled (or everything measured as noise): hand back the
+    # smallest tile — the most likely to fit — and do not cache it.
+    monkeypatch.setattr(autotune, "_measure", lambda *a, **k: float("inf"))
+    blocks = tune_flash_blocks(
+        batch=1, seq_len=64, heads=2, head_dim=16,
+        candidates=((64, 64), (16, 16), (64, 16)), use_cache=True,
+    )
+    assert blocks == (16, 16)
+    assert autotune._read_cache("anything") is None and not os.path.exists(
+        autotune._cache_path()
+    )
+
+
+def test_non_vmem_compile_error_propagates():
+    # Only memory-shaped failures measure as inf; a broken program must
+    # raise so the caller learns the kernel cannot run at this shape.
+    import jax.numpy as jnp
+
+    def boom(q, k, v):
+        raise ValueError("head_dim violates Mosaic tiling rules")
+
+    q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="tiling rules"):
+        autotune._measure(boom, q, k, v)
+
+
+def test_hbm_oom_propagates():
+    # HBM OOM carries RESOURCE_EXHAUSTED too, but no block size fixes
+    # it — tuning must fail loudly, not "win" with the smallest tile.
+    import jax.numpy as jnp
+
+    def boom(q, k, v):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 12884901888 "
+            "bytes in hbm"
+        )
+
+    q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
+    with pytest.raises(RuntimeError, match="in hbm"):
+        autotune._measure(boom, q, k, v)

@@ -122,3 +122,58 @@ class TestPeakTable:
 
     def test_unknown_kind_omits_mfu(self, bench):
         assert bench._peak_tflops("cpu") is None
+
+
+class TestFirstFittingBlocks:
+    """The flash phases walk a block-size ladder because scoped-vmem
+    budgets vary by chip generation (v5e lost [1024,1024]+bias by 576K
+    in the round-4 capture)."""
+
+    def test_first_candidate_fits(self, bench):
+        t, blocks, demoted = bench._first_fitting_blocks(
+            bench_fn=lambda step: step,
+            mk_step=lambda f: f,
+            mk_flash=lambda block_q, block_k: (block_q, block_k),
+            ladder=[(1024, 1024), (512, 512)],
+        )
+        assert (t, blocks, demoted) == ((1024, 1024), (1024, 1024), False)
+
+    def test_oom_demotes_down_the_ladder(self, bench):
+        def bench_fn(step):
+            if step[0] * step[1] > 512 * 512:
+                raise RuntimeError("scoped vmem exceeded")
+            return 0.001
+
+        t, blocks, demoted = bench._first_fitting_blocks(
+            bench_fn=bench_fn,
+            mk_step=lambda f: f,
+            mk_flash=lambda block_q, block_k: (block_q, block_k),
+            ladder=[(1024, 1024), (1024, 512), (512, 512)],
+        )
+        assert blocks == (512, 512) and demoted and t == 0.001
+
+    def test_nothing_fits_reraises_last_error(self, bench):
+        def bench_fn(step):
+            raise RuntimeError(f"scoped vmem exceeded at {step}")
+
+        with pytest.raises(RuntimeError, match=r"vmem exceeded at \(256, 256\)"):
+            bench._first_fitting_blocks(
+                bench_fn=bench_fn,
+                mk_step=lambda f: f,
+                mk_flash=lambda block_q, block_k: (block_q, block_k),
+                ladder=[(512, 512), (256, 256)],
+            )
+
+    def test_non_vmem_error_propagates_without_demotion(self, bench):
+        # A tunnel hiccup on the first candidate must surface, NOT be
+        # mislabeled as a vmem demotion with numbers at smaller blocks.
+        def bench_fn(step):
+            raise RuntimeError("axon tunnel: HTTP 502")
+
+        with pytest.raises(RuntimeError, match="HTTP 502"):
+            bench._first_fitting_blocks(
+                bench_fn=bench_fn,
+                mk_step=lambda f: f,
+                mk_flash=lambda block_q, block_k: (block_q, block_k),
+                ladder=[(1024, 1024), (512, 512)],
+            )

@@ -86,6 +86,20 @@ def _write_cache(key: str, blocks: Tuple[int, int]) -> None:
         pass  # tuning still returns the measured answer
 
 
+def _is_vmem_error(e: BaseException) -> bool:
+    """Does this exception look like a Mosaic scoped-vmem overrun — a
+    BLOCK-SIZE-dependent failure a tuner/bench may step down from — as
+    opposed to a tunnel hiccup, a broken program, or an HBM OOM (which
+    no block size fixes)?  Matched on message text because the failure
+    arrives as a generic XlaRuntimeError; the v5e wording is 'Scoped
+    allocation with size ... exceeded scoped vmem limit' (status
+    RESOURCE_EXHAUSTED — deliberately NOT matched bare: HBM OOM carries
+    the same status and must propagate).  Single source of truth for
+    both the autotuner and bench.py's block ladder."""
+    s = str(e)
+    return any(m in s for m in ("vmem", "VMEM", "Scoped allocation"))
+
+
 def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
     """Per-iteration seconds via the chain scheme (see bench.py): N
     data-dependent steps inside one jit, difference two N values.
@@ -102,8 +116,20 @@ def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
 
     lo = jnp.asarray(n_lo, jnp.int32)
     hi = jnp.asarray(n_hi, jnp.int32)
-    float(g(q, lo))  # compile + warm
-    float(g(q, hi))
+    try:
+        float(g(q, lo))  # compile + warm
+        float(g(q, hi))
+    except Exception as e:
+        # A candidate whose tiles overrun the chip's scoped vmem fails
+        # Mosaic compilation (v5e: [1024,1024] + f32 bias tile).  It
+        # simply cannot win; let the survivors compete.  Anything NOT
+        # memory-shaped (a tunnel hiccup, a genuinely broken program)
+        # propagates — otherwise tuning would "succeed" with the
+        # smallest tile and the caller would never learn the kernel
+        # cannot run at all.
+        if _is_vmem_error(e):
+            return float("inf")
+        raise
     deltas = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -208,12 +234,13 @@ def tune_flash_blocks(
         if t < best_t:
             best, best_t = (bq, bk), t
     if best is None:
-        # Every candidate measured as pure noise (host hiccups): return
-        # an arbitrary pick for this call, but do NOT cache it — a
+        # Every candidate measured as pure noise (host hiccups) or
+        # failed to compile: return the smallest-tile pick — the one
+        # most likely to fit scoped vmem — but do NOT cache it; a
         # transient hiccup must not permanently pin an unmeasured block
         # size for this (device, shape, dtype) key; the next launch
         # re-measures.
-        return clamped[0]
+        return min(clamped, key=lambda c: c[0] * c[1])
     if use_cache:
         _write_cache(key, best)
     return best
