@@ -407,21 +407,24 @@ def _flash_phase(mode: str) -> dict:
     # (pallas interpret mode is far too slow at the real shape on CPU).
     B, H, S, D = _env_ints("TDX_FLASH_SHAPE", "4,16,2048,64", 4)
 
-    # Block sizes: the defaults (1024x1024) are the measured winner on
-    # v5e at this shape (round-2 hand search, now the autotuner's job).
-    # On an UNKNOWN accelerator kind — or when TDX_BENCH_TUNE=1 — run
-    # the cached autotuner so the phase reports the chip's best blocks
-    # instead of another chip's; on known kinds skip it (each candidate
-    # costs a cold Mosaic compile through the tunnel).
+    # Block sizes: per-workload defaults measured on v5e at the default
+    # shape IN THIS PHASE'S chained-step context (see docs/benchmarks.md
+    # §Block sizes): isolated-kernel sweep winners did not transfer —
+    # fwd (2048, 2048) measured 2.3x faster standalone but vmem-demoted
+    # or hung the phase's fori_loop program, and bwd (512, 2048)'s
+    # standalone 2.6x inverted to 0.8x in the realistic
+    # fwd+3-cotangent chain — so fwd/bwd keep the reliably-landing
+    # 1024x1024 and only the bias flavor (512x1024, 15% better MFU
+    # on-chip in-phase) changes.  On an UNKNOWN accelerator kind — or
+    # when TDX_BENCH_TUNE=1 — run the cached autotuner so the phase
+    # reports the chip's best blocks instead of another chip's; on
+    # known kinds skip it (each candidate costs a cold Mosaic compile
+    # through the tunnel).  Configs that don't fit a chip's vmem demote
+    # down the ladder below.
     kind = jax.devices()[0].device_kind
-    bq = bk = 1024
-    if mode == "bias":
-        # The f32 [bq, bk] bias tile is double-buffered into scoped vmem
-        # alongside q/k/v: at [1024, 1024] that overran v5e's 16MB scoped
-        # budget by 576K in the round-4 hardware capture.  [1024, 512]
-        # halves the bias tile; _first_fitting_blocks below still steps
-        # down further on chips with tighter vmem.
-        bq, bk = 1024, 512
+    bq, bk = {
+        "fwd": (1024, 1024), "bwd": (1024, 1024), "bias": (512, 1024),
+    }[mode]
     autotuned = False
     known = any(s in kind.lower() for s in ("v5 lite", "v5e", "v5litepod"))
     if jax.default_backend() != "cpu" and (
@@ -510,9 +513,14 @@ def _flash_phase(mode: str) -> dict:
         t_hi = time.perf_counter() - t0
         return (t_hi - t_lo) / (n_hi - n_lo)
 
+    # A demotion step must use a STRICTLY smaller tile product: scores
+    # and bias tiles hold bq*bk elements, so an equal-or-larger product
+    # can only fail the same vmem budget again (at the cost of another
+    # cold Mosaic compile through the tunnel).
     ladder = [(bq, bk)] + [
-        c for c in ((1024, 512), (512, 512), (512, 256), (256, 256))
-        if c != (bq, bk)
+        c for c in ((1024, 1024), (1024, 512), (512, 512), (512, 256),
+                    (256, 256))
+        if c[0] * c[1] < bq * bk
     ]
     t_flash, (bq, bk), demoted = _first_fitting_blocks(
         bench, make_step, make_flash_attention, ladder

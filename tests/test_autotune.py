@@ -74,18 +74,20 @@ def test_empty_candidates_raise(cache_dir):
         )
 
 
-def test_compile_failure_measures_as_inf():
+def test_compile_failure_raises_block_config_error():
     # A candidate whose tiles overrun scoped vmem dies in Mosaic
     # compilation (v5e: [1024,1024] + f32 bias tile, round-4 capture).
-    # _measure must report +inf — not propagate — so the survivors
-    # compete and tuning completes on any chip generation.
+    # _measure flags it as a per-config failure (BlockConfigError) so
+    # the tuner can let survivors compete — and still detect the
+    # all-configs-failed systemic case.
     import jax.numpy as jnp
 
     def boom(q, k, v):
         raise RuntimeError("RESOURCE_EXHAUSTED: scoped vmem")
 
     q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
-    assert autotune._measure(boom, q, k, v) == float("inf")
+    with pytest.raises(autotune.BlockConfigError):
+        autotune._measure(boom, q, k, v)
 
 
 def test_oom_candidate_loses_to_fitting_one(cache_dir, monkeypatch):
@@ -109,8 +111,8 @@ def test_oom_candidate_loses_to_fitting_one(cache_dir, monkeypatch):
     assert blocks == (16, 16)
 
 
-def test_all_candidates_failing_returns_smallest(cache_dir, monkeypatch):
-    # Nothing compiled (or everything measured as noise): hand back the
+def test_all_candidates_noise_returns_smallest(cache_dir, monkeypatch):
+    # Everything measured as noise (host hiccups): hand back the
     # smallest tile — the most likely to fit — and do not cache it.
     monkeypatch.setattr(autotune, "_measure", lambda *a, **k: float("inf"))
     blocks = tune_flash_blocks(
@@ -121,6 +123,21 @@ def test_all_candidates_failing_returns_smallest(cache_dir, monkeypatch):
     assert autotune._read_cache("anything") is None and not os.path.exists(
         autotune._cache_path()
     )
+
+
+def test_all_candidates_compile_failing_raises(cache_dir, monkeypatch):
+    # EVERY config crashing the compiler is systemic (broken helper
+    # env, a Mosaic bug) — tuning must not "succeed" with the smallest
+    # tile as if it had measured something.
+    def boom(*a, **k):
+        raise autotune.BlockConfigError("tpu_compile_helper subprocess exit code 1")
+
+    monkeypatch.setattr(autotune, "_measure", boom)
+    with pytest.raises(autotune.BlockConfigError):
+        tune_flash_blocks(
+            batch=1, seq_len=64, heads=2, head_dim=16,
+            candidates=((64, 64), (16, 16)), use_cache=False,
+        )
 
 
 def test_non_vmem_compile_error_propagates():

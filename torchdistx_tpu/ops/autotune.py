@@ -34,6 +34,16 @@ from jax import lax
 # family streams (minor dims 128-divisible; see flash_attention.py).
 DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
     (512, 512), (512, 1024), (1024, 512), (1024, 1024), (2048, 1024),
+    # Round-4 ISOLATED-kernel sweep winners (v5e, S=2048): whole-
+    # sequence blocks won the standalone forward 2.3x and short-q/
+    # full-k the standalone backward 2.6x — but neither transferred to
+    # the bench's chained-step context (docs/benchmarks.md §Block
+    # sizes), which is why they are candidates here, not defaults:
+    # _measure now times the bench's exact chain, so a chip where they
+    # genuinely win will still pick them.  A candidate that fails
+    # compilation for vmem is skipped (BlockConfigError); if every
+    # candidate fails, tuning raises rather than guessing.
+    (2048, 2048), (512, 2048),
 )
 
 
@@ -94,15 +104,36 @@ def _is_vmem_error(e: BaseException) -> bool:
     arrives as a generic XlaRuntimeError; the v5e wording is 'Scoped
     allocation with size ... exceeded scoped vmem limit' (status
     RESOURCE_EXHAUSTED — deliberately NOT matched bare: HBM OOM carries
-    the same status and must propagate).  Single source of truth for
-    both the autotuner and bench.py's block ladder."""
+    the same status and must propagate).  The axon remote-AOT compile
+    path instead crashes its helper subprocess on the same overrun,
+    reporting only 'HTTP 500: tpu_compile_helper subprocess exit code
+    1' (observed for the exact configs the runtime path rejects for
+    vmem, round-4 sweep) — matched too, since in a block ladder the
+    recovery (step down, or re-raise when the smallest config also
+    fails) is right for any per-config compile crash.  Single source of
+    truth for both the autotuner and bench.py's block ladder."""
     s = str(e)
-    return any(m in s for m in ("vmem", "VMEM", "Scoped allocation"))
+    return any(m in s for m in ("vmem", "VMEM", "Scoped allocation",
+                                "tpu_compile_helper subprocess exit code"))
 
 
-def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
-    """Per-iteration seconds via the chain scheme (see bench.py): N
-    data-dependent steps inside one jit, difference two N values.
+class BlockConfigError(RuntimeError):
+    """A single block config failed to compile for a memory-shaped
+    reason (scoped vmem / per-config compile crash).  The tuner treats
+    it as +inf so survivors compete; if EVERY candidate raises it, the
+    failure is systemic and :func:`tune_flash_blocks` re-raises."""
+
+
+def _measure(fn, q, k, v, *, extra=(), n_lo=2, n_hi=10, repeats=2) -> float:
+    """Per-iteration seconds via THE BENCH'S chain scheme (bench.py
+    `_flash_phase`): N data-dependent steps inside one jit, difference
+    two N values.  ``fn(*carry) -> carry`` threads the full
+    ``(q, k, v, *extra)`` tuple — a bwd workload feeds ALL THREE
+    cotangents back exactly like a training step (a dq-only chain
+    flattered (512, 2048) by 2.6x in the round-4 sweep, which inverted
+    to 0.8x in the real phase), and a bias operand rides the carry
+    rather than a closure (jit embeds captured arrays as program
+    constants: a [H, S, S] f32 constant 413s the axon remote-compile).
 
     The lo/hi pair is repeated and the smallest positive delta wins —
     one host-side hiccup (GC pause, tunnel latency spike) must not pin a
@@ -110,15 +141,16 @@ def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
     are pure noise: report +inf so the candidate cannot win on junk."""
 
     @jax.jit
-    def g(q, n):
-        out = lax.fori_loop(0, n, lambda i, x: fn(x, k, v).astype(x.dtype), q)
-        return out.sum()
+    def g(carry, n):
+        out = lax.fori_loop(0, n, lambda i, c: tuple(fn(*c)), carry)
+        return sum(x.sum() for x in out[:3])
 
+    carry = (q, k, v, *extra)
     lo = jnp.asarray(n_lo, jnp.int32)
     hi = jnp.asarray(n_hi, jnp.int32)
     try:
-        float(g(q, lo))  # compile + warm
-        float(g(q, hi))
+        float(g(carry, lo))  # compile + warm
+        float(g(carry, hi))
     except Exception as e:
         # A candidate whose tiles overrun the chip's scoped vmem fails
         # Mosaic compilation (v5e: [1024,1024] + f32 bias tile).  It
@@ -128,15 +160,15 @@ def _measure(fn, q, k, v, n_lo=2, n_hi=10, repeats=2) -> float:
         # smallest tile and the caller would never learn the kernel
         # cannot run at all.
         if _is_vmem_error(e):
-            return float("inf")
+            raise BlockConfigError(str(e)) from e
         raise
     deltas = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        float(g(q, lo))
+        float(g(carry, lo))
         t_lo = time.perf_counter() - t0
         t0 = time.perf_counter()
-        float(g(q, hi))
+        float(g(carry, hi))
         t_hi = time.perf_counter() - t0
         deltas.append((t_hi - t_lo) / (n_hi - n_lo))
     pos = [d for d in deltas if d > 0]
@@ -210,13 +242,14 @@ def tune_flash_blocks(
     )
 
     best, best_t = None, float("inf")
+    compiled = []  # configs that did not crash the compiler
+    cfg_failures, last_cfg_err = 0, None
     for bq, bk in clamped:
 
-        def fn(q, k, v, bq=bq, bk=bk):
+        def fn(q, k, v, *rest, bq=bq, bk=bk):
+            # Mirrors the bench phase's step exactly (see _measure's
+            # docstring for why fidelity matters here).
             if workload == "bwd":
-                # Time what a training step runs: fwd + dq/dkv kernels.
-                # dk/dv feed the return (summed in) so neither backward
-                # kernel can be dead-code-eliminated.
                 dq, dk, dv = jax.grad(
                     lambda qq, kk, vv: flash_attention(
                         qq, kk, vv, causal=causal, block_q=bq, block_k=bk,
@@ -224,23 +257,40 @@ def tune_flash_blocks(
                     ).astype(jnp.float32).sum(),
                     argnums=(0, 1, 2),
                 )(q, k, v)
-                return dq + (dk.sum() + dv.sum()).astype(dq.dtype)
-            return flash_attention(
-                q, k, v, causal=causal, bias=bias, block_q=bq, block_k=bk,
-                interpret=interpret,
+                return (
+                    (q + 1e-6 * dq).astype(q.dtype),
+                    (k + 1e-6 * dk).astype(k.dtype),
+                    (v + 1e-6 * dv).astype(v.dtype),
+                )
+            out = flash_attention(
+                q, k, v, causal=causal, bias=(rest[0] if rest else None),
+                block_q=bq, block_k=bk, interpret=interpret,
             )
+            return (out.astype(q.dtype), k, v, *rest)
 
-        t = _measure(fn, q, k, v)
+        try:
+            t = _measure(fn, q, k, v,
+                         extra=(() if bias is None else (bias,)))
+        except BlockConfigError as e:
+            cfg_failures += 1
+            last_cfg_err = e
+            continue
+        compiled.append((bq, bk))
         if t < best_t:
             best, best_t = (bq, bk), t
+    if cfg_failures == len(clamped):
+        # EVERY config crashed the compiler: that is systemic (broken
+        # helper env, a Mosaic bug), not a block-size problem — raise
+        # so the caller learns the kernel cannot run at all.
+        raise last_cfg_err
     if best is None:
-        # Every candidate measured as pure noise (host hiccups) or
-        # failed to compile: return the smallest-tile pick — the one
-        # most likely to fit scoped vmem — but do NOT cache it; a
+        # Every candidate that COMPILED measured as pure noise (host
+        # hiccups): return the smallest-tile pick among those — never a
+        # config just observed to crash — but do NOT cache it; a
         # transient hiccup must not permanently pin an unmeasured block
         # size for this (device, shape, dtype) key; the next launch
         # re-measures.
-        return min(clamped, key=lambda c: c[0] * c[1])
+        return min(compiled, key=lambda c: c[0] * c[1])
     if use_cache:
         _write_cache(key, best)
     return best
