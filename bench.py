@@ -338,6 +338,34 @@ def phase_llama70b_lower() -> dict:
     }
 
 
+def _chain_iters(env_name: str, default: str):
+    """(n_lo, n_hi) trip counts for the chain scheme, validated."""
+    n_lo, n_hi = _env_ints(env_name, default, 2)
+    if n_hi <= n_lo:
+        raise ValueError(f"{env_name}: need n_hi > n_lo, got {n_lo},{n_hi}")
+    return n_lo, n_hi
+
+
+def _chain_time(jnp, g, carry, n_lo: int, n_hi: int) -> float:
+    """Per-iteration seconds via the chain scheme: ``g(carry, n)`` runs
+    n data-dependent steps inside ONE jitted program (dynamic trip
+    count — a single compile serves both n values); differencing the
+    two wall times cancels dispatch latency and tunnel round-trips.
+    THE timing harness for every chained phase (flash flavors,
+    train_mfu) — methodology edits land here once."""
+    lo = jnp.asarray(n_lo, jnp.int32)
+    hi = jnp.asarray(n_hi, jnp.int32)
+    float(g(carry, lo))  # compile + warm
+    float(g(carry, hi))
+    t0 = time.perf_counter()
+    float(g(carry, lo))
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(g(carry, hi))
+    t_hi = time.perf_counter() - t0
+    return (t_hi - t_lo) / (n_hi - n_lo)
+
+
 def _env_ints(name: str, default: str, n: int):
     raw = os.environ.get(name) or default
     vals = [int(x) for x in raw.split(",")]
@@ -491,9 +519,7 @@ def _flash_phase(mode: str) -> dict:
 
         return step
 
-    n_lo, n_hi = _env_ints("TDX_FLASH_ITERS", "2,34", 2)
-    if n_hi <= n_lo:
-        raise ValueError(f"TDX_FLASH_ITERS: need n_hi > n_lo, got {n_lo},{n_hi}")
+    n_lo, n_hi = _chain_iters("TDX_FLASH_ITERS", "2,34")
 
     def bench(step):
         @jax.jit
@@ -501,17 +527,7 @@ def _flash_phase(mode: str) -> dict:
             out = lax.fori_loop(0, n, lambda i, c: step(c), carry)
             return sum(leaf.sum() for leaf in jax.tree.leaves(out))
 
-        lo = jnp.asarray(n_lo, jnp.int32)
-        hi = jnp.asarray(n_hi, jnp.int32)
-        float(g(init_carry, lo))  # compile + warm
-        float(g(init_carry, hi))
-        t0 = time.perf_counter()
-        float(g(init_carry, lo))
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(g(init_carry, hi))
-        t_hi = time.perf_counter() - t0
-        return (t_hi - t_lo) / (n_hi - n_lo)
+        return _chain_time(jnp, g, init_carry, n_lo, n_hi)
 
     # A demotion step must use a STRICTLY smaller tile product: scores
     # and bias tiles hold bq*bk elements, so an equal-or-larger product
@@ -555,6 +571,96 @@ def phase_flash_bwd() -> dict:
 
 def phase_flash_bias() -> dict:
     return _flash_phase("bias")
+
+
+def phase_train_mfu() -> dict:
+    """End-to-end single-chip training MFU on a llama-class model — the
+    model-level complement to the flash phases' kernel-level MFU (the
+    charter judges single-chip MFU).
+
+    Default config (TDX_TRAIN_SHAPE=B,S,d_model,layers,heads): ~370M
+    params (d=1024, L=24, H=16, SwiGLU d_ff=2816, vocab 32000), bf16
+    compute / f32 params+Adam, full remat, flash-attention blocks at
+    the chip defaults, B=4 x S=2048 tokens per step.  The step is the
+    REAL production path: `make_train_step`'s jitted AdamW update
+    (value_and_grad over the model, optax update, new state).
+
+    Timing: the chain scheme (state threads through `lax.fori_loop`,
+    two trip counts differenced) — identical methodology to the flash
+    phases, so tunnel latency cancels.
+
+    FLOP accounting (reported, so the MFU is auditable):
+    ``6 * N_matmul * tokens`` for the parameter matmuls (fwd 2 + bwd 4;
+    N_matmul excludes the embedding gather but includes the untied LM
+    head) plus the causal attention term ``6 * B*H*S^2*Dh * L`` (2 fwd
+    + 4 bwd USEFUL matmuls over the S^2/2 plane; the flash backward's
+    2 recompute matmuls are implementation cost, excluded).  Remat's
+    recompute FLOPs are NOT counted either — MFU counts useful work,
+    so rematerialisation honestly lowers it."""
+    os.environ.setdefault("TDX_CACHE_DIR", BCACHE_DIR)
+    jax = _init_jax(cache=True)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh
+
+    from torchdistx_tpu.models import make_llama
+    from torchdistx_tpu.models.configs import TransformerConfig
+    from torchdistx_tpu.ops import make_flash_attention
+    from torchdistx_tpu.parallel.train import make_train_step
+
+    B, S, d, L, H = _env_ints("TDX_TRAIN_SHAPE", "4,2048,1024,24,16", 5)
+    d_ff = 11 * d // 4  # SwiGLU sizing (~2.75x)
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=d, n_layers=L, n_heads=H, d_ff=d_ff,
+        max_seq_len=S, remat="full",
+    )
+    attn = make_flash_attention()
+    model = make_llama(cfg, attn_fn=attn)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size
+    )
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), tokens)
+    init_state, train_step, shard_batch = make_train_step(
+        model, cfg, mesh, attn_fn=attn,
+    )
+    state = init_state(params)
+    tokens = shard_batch(tokens)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+
+    n_lo, n_hi = _chain_iters("TDX_TRAIN_ITERS", "1,4")
+
+    @jax.jit
+    def g(state, n):
+        out = lax.fori_loop(0, n, lambda i, st: train_step(st, tokens)[0],
+                            state)
+        # One leaf suffices to gate the fetch; the while-loop body
+        # computes the full carry every iteration regardless.
+        return jax.tree.leaves(out["params"])[0].sum()
+
+    t = _chain_time(jnp, g, state, n_lo, n_hi)
+
+    Dh = cfg.head_size
+    n_matmul = L * (4 * d * d + 3 * d * d_ff) + d * cfg.vocab_size
+    # Useful attention matmuls fwd+bwd = 2 + 4 = 6 over the S^2/2
+    # causal plane (1 unit == B*H*S^2*Dh flops, matching the flash
+    # fwd=2 convention).  NOT the flash_bwd phase's 7: its 2 recompute
+    # matmuls are implementation cost, excluded like remat's.
+    flops = 6.0 * n_matmul * B * S + 6.0 * B * H * S * S * Dh * L
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind)
+    out = {
+        "step_ms": round(t * 1e3, 3),
+        "tokens_per_s": round(B * S / t),
+        "tflops": round(flops / t / 1e12, 2),
+        "n_params": n_params,
+        "device_kind": kind,
+        "rss_mb": round(_rss_mb(), 1),
+    }
+    if peak is not None:
+        out["mfu"] = round(flops / t / 1e12 / peak, 4)
+    return out
 
 
 def phase_pp_bubble() -> dict:
@@ -607,6 +713,7 @@ PHASES = {
     "flash_bwd": phase_flash_bwd,
     "flash_bias": phase_flash_bias,
     "pp_bubble": phase_pp_bubble,
+    "train_mfu": phase_train_mfu,
 }
 
 
@@ -708,6 +815,23 @@ def _merge_flash_result(out: dict, name: str, result: dict) -> None:
     out.update(mapped)
 
 
+def _merge_train_result(out: dict, result: dict) -> None:
+    """train_* key scheme — ONE mapping for fresh, cache-fallback, and
+    promoted results, so staleness labels (`train_stale_s`) and
+    measurements always land under the same names."""
+    out.update({f"train_{k}": v for k, v in result.items()
+                if k != "device_kind"})
+
+
+def _merge_cached_train(out: dict) -> None:
+    """Attach the last hardware train_mfu measurement, age-labeled."""
+    c = _read_hw_cache("train_mfu")
+    if c is None:
+        return
+    _merge_train_result(out, c["result"])
+    out["train_stale_s"] = round(time.time() - c["ts"])
+
+
 def _read_hw_cache(name: str):
     """Last cached HARDWARE measurement of a phase, or None — entries
     from CPU-forced runs (or unstamped legacy ones) never qualify."""
@@ -715,13 +839,14 @@ def _read_hw_cache(name: str):
         with open(_cache_path(name)) as f:
             cached = json.load(f)
         result = cached.get("result", {})
-        # A real measurement carries a wall time ("t") or a per-iteration
-        # kernel time ("flash_ms" — the flash phases have no "t").  Only
-        # entries stamped with a TRUE accelerator backend name qualify:
-        # "default" is the legacy env-based stamp, which a
-        # silently-failed accelerator plugin could have earned on CPU.
+        # A real measurement carries a wall time ("t"), a per-iteration
+        # kernel time ("flash_ms" — the flash phases have no "t"), or a
+        # per-step time ("step_ms", train_mfu).  Only entries stamped
+        # with a TRUE accelerator backend name qualify: "default" is
+        # the legacy env-based stamp, which a silently-failed
+        # accelerator plugin could have earned on CPU.
         if cached.get("platform") in (None, "cpu", "default") or not (
-            "t" in result or "flash_ms" in result
+            "t" in result or "flash_ms" in result or "step_ms" in result
         ):
             return None
         return cached
@@ -907,6 +1032,8 @@ def main() -> None:
         for name in ("flash", "flash_bwd", "flash_bias"):
             out[f"{name}_skipped"] = "accelerator unavailable"
             _merge_cached_flash(out, name)
+        out["train_mfu_skipped"] = "accelerator unavailable"
+        _merge_cached_train(out)
     else:
         llama_ours = _run_phase("llama_ours", cache_fallback=True)
         if "error" not in llama_ours:
@@ -1002,6 +1129,15 @@ def main() -> None:
                 _merge_cached_flash(out, name)
             else:
                 _merge_flash_result(out, name, r)
+        r = _run_phase("train_mfu", timeout=1500.0, cache_fallback=True)
+        backend = r.pop("_backend", None)
+        if "error" in r:
+            out["train_mfu_error"] = r["error"][-160:]
+        elif backend == "cpu" and not forced:
+            out["train_mfu_skipped"] = "phase ran on cpu"
+            _merge_cached_train(out)
+        else:
+            _merge_train_result(out, r)
 
     print(json.dumps(out))
 

@@ -177,3 +177,29 @@ class TestFirstFittingBlocks:
                 mk_flash=lambda block_q, block_k: (block_q, block_k),
                 ladder=[(1024, 1024), (512, 512)],
             )
+
+
+class TestMergeTrain:
+    def test_cached_and_fresh_share_key_scheme(self, bench):
+        _write(bench, "train_mfu", "tpu",
+               {"step_ms": 412.0, "mfu": 0.31, "tflops": 61.0,
+                "device_kind": "TPU v5 lite"}, ts=time.time() - 100)
+        out = {}
+        bench._merge_cached_train(out)
+        assert out["train_step_ms"] == 412.0 and out["train_mfu"] == 0.31
+        assert 90 <= out["train_stale_s"] <= 110
+        assert "train_device_kind" not in out  # kind stays phase-local
+        fresh = {}
+        bench._merge_train_result(
+            fresh, {"step_ms": 400.0, "mfu": 0.32, "stale_s": 55}
+        )
+        # The cache-fallback path (stale_s inside the result) lands on
+        # the SAME key the promoted path uses — never train_mfu_stale_s.
+        assert fresh["train_stale_s"] == 55
+        assert set(out) & {"train_mfu_stale_s"} == set()
+
+    def test_cpu_stamped_train_cache_never_merges(self, bench):
+        _write(bench, "train_mfu", "cpu", {"step_ms": 9.0, "mfu": 0.9})
+        out = {}
+        bench._merge_cached_train(out)
+        assert out == {}

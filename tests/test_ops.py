@@ -379,3 +379,35 @@ def test_t5_packed_enc_dec():
     assert float(jnp.abs(ref.astype(jnp.float32) - out.astype(jnp.float32)).max()) < 2e-5
     unpacked = base.apply(params, toks, dec)
     assert float(jnp.abs(ref - unpacked).max()) > 1e-4  # masking is real
+
+
+def test_flash_under_remat_train_step():
+    # remat='full' + flash is the standard long-context training config.
+    # nn.remat converts every CALL argument to a traced array, and a
+    # traced `causal` bool reaching _flash_core's static nondiff_argnums
+    # is an UnexpectedTracerError — which is why Block carries causal as
+    # a module FIELD (round-4 find, via the train-MFU bench phase).
+    # Gradients must also match the unremat'd model exactly (remat
+    # recomputes the same values).
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from torchdistx_tpu.models import make_llama
+    from torchdistx_tpu.models.configs import TransformerConfig
+    from torchdistx_tpu.parallel.train import make_train_step
+
+    base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=88,
+                max_seq_len=32)
+    attn = make_flash_attention(block_q=16, block_k=16)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    losses = {}
+    for remat in ("none", "full"):
+        cfg = TransformerConfig(**base, remat=remat)
+        model = make_llama(cfg, attn_fn=attn)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), toks)
+        init_state, step, shard = make_train_step(model, cfg, mesh, attn_fn=attn)
+        st, m = step(init_state(params), shard(toks))
+        st, m2 = step(st, shard(toks))  # second step exercises donation
+        losses[remat] = (float(m["loss"]), float(m2["loss"]))
+    assert losses["none"] == pytest.approx(losses["full"], rel=1e-5), losses

@@ -26,6 +26,7 @@ HW_PHASES = [
     ("flash", 900.0),
     ("flash_bwd", 900.0),
     ("flash_bias", 900.0),
+    ("train_mfu", 1500.0),
 ]
 
 

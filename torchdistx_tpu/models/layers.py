@@ -286,15 +286,23 @@ class MoEMLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block; MoE if the config says so."""
+    """Pre-norm transformer block; MoE if the config says so.
+
+    ``causal`` is a module FIELD, not a call argument: it is constant
+    per model family, and under ``nn.remat`` every call argument is
+    converted to a traced array — a traced bool reaching a flash-
+    attention ``custom_vjp``'s static ``nondiff_argnums`` position is
+    an UnexpectedTracerError (found wiring remat='full' + flash into
+    the train-MFU bench phase)."""
 
     cfg: TransformerConfig
     attn_fn: AttnFn = default_attention
+    causal: bool = True
 
     @nn.compact
-    def __call__(self, x, *, angles=None, bias=None, causal=True,
-                 segment_ids=None):
+    def __call__(self, x, *, angles=None, bias=None, segment_ids=None):
         cfg = self.cfg
+        causal = self.causal
         h = make_norm(cfg)(x)
         x = x + Attention(cfg, attn_fn=self.attn_fn, name="attn")(
             h, angles=angles, bias=bias, causal=causal,

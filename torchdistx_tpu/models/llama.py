@@ -35,9 +35,9 @@ class _BlockWithCarry(nn.Module):
         block_cls = Block
         if self.cfg.remat == "full":
             block_cls = nn.remat(Block, prevent_cse=False, static_argnums=())
-        x = block_cls(self.cfg, attn_fn=self.attn_fn, name="block")(
-            x, angles=angles, causal=self.causal, segment_ids=segs
-        )
+        x = block_cls(
+            self.cfg, attn_fn=self.attn_fn, causal=self.causal, name="block"
+        )(x, angles=angles, segment_ids=segs)
         return (x, angles, segs), None
 
 

@@ -132,7 +132,7 @@ def pipeline_forward(
 
 
 def _block_chain(cfg: TransformerConfig, attn_fn, angles, causal=True):
-    block = Block(cfg, attn_fn=attn_fn)
+    block = Block(cfg, attn_fn=attn_fn, causal=causal)
     collect_aux = cfg.moe is not None
 
     def chain(stacked_params, x, segs=None):
@@ -140,13 +140,13 @@ def _block_chain(cfg: TransformerConfig, attn_fn, angles, causal=True):
             x, aux = carry
             if collect_aux:
                 y, mvars = block.apply(
-                    {"params": layer_params}, x, angles=angles, causal=causal,
+                    {"params": layer_params}, x, angles=angles,
                     segment_ids=segs, mutable=["losses"],
                 )
                 aux = aux + _sum_aux(mvars.get("losses", {}))
             else:
                 y = block.apply(
-                    {"params": layer_params}, x, angles=angles, causal=causal,
+                    {"params": layer_params}, x, angles=angles,
                     segment_ids=segs,
                 )
             return (y, aux), None
