@@ -145,23 +145,27 @@ def main() -> int:
     done = {m: 0 for m in modes}
     failures = 0
     ctx = mp.get_context("spawn")
-    with ctx.Pool(args.workers, initializer=_init_worker) as pool:
-        # chunksize must stay 1: with chunksize>1 imap_unordered returns a
-        # plain unchunking generator without .next(timeout) (py3.12).
+    # No with-block: Pool.__exit__ re-JOINS a terminated pool, which can
+    # deadlock on py3.12 spawn pools whose worker died mid-send (observed:
+    # a 2h soak hung 40+ min past its budget, summary never printed).
+    # Cleanup is an unconditional terminate (never join) in the finally
+    # below, plus a hard os._exit at the __main__ site so interpreter
+    # atexit can't re-join either.
+    pool = ctx.Pool(args.workers, initializer=_init_worker)
+    try:
+        # chunksize must stay 1: with chunksize>1 imap_unordered returns
+        # a plain unchunking generator without .next(timeout) (py3.12).
         it = pool.imap_unordered(_worker, tasks())
         while True:
-            # next(timeout=...) so the budget fires even if a worker hangs
-            # (an XLA compile deadlock must not run the soak past budget).
+            # next(timeout=...) so the budget fires even if a worker
+            # hangs (an XLA compile deadlock must not run the soak past
+            # budget).
             remaining = args.seconds - (time.time() - t0)
             if remaining <= 0:
-                pool.terminate()
                 break
             try:
                 mode, seed, r = it.next(timeout=max(1.0, remaining))
-            except mp.TimeoutError:
-                pool.terminate()
-                break
-            except StopIteration:
+            except (mp.TimeoutError, StopIteration):
                 break
             done[mode] += 1
             if r is not None:
@@ -176,12 +180,20 @@ def main() -> int:
                 rate = n / (time.time() - t0)
                 print(f"[{time.time()-t0:7.0f}s] {n} programs "
                       f"({rate:.1f}/s), {failures} failures", flush=True)
+    finally:
+        pool.terminate()  # every exit path: budget, exhaustion, exception
     total = sum(done.values())
     print(json.dumps({"programs": total, "failures": failures,
                       "seconds": round(time.time() - t0, 1),
-                      "per_mode": done}))
+                      "per_mode": done}), flush=True)
     return 1 if failures else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # Hard exit, skipping interpreter teardown: see the pool-creation
+    # comment — atexit's re-join of the terminated spawn pool can
+    # deadlock; everything worth keeping is already flushed.
+    os._exit(rc)
