@@ -240,3 +240,54 @@ def test_train_mfu_flop_accounting(bench, monkeypatch, tmp_path):
     assert r["tflops"] == pytest.approx(flops / t / 1e12, abs=0.011)
     assert r["tokens_per_s"] == pytest.approx(B * S / t, abs=1.0)
     assert "mfu" not in r  # cpu kind has no peak table entry
+
+
+class TestHeadlineLine:
+    """The driver records only ~2000 tail characters of stdout; the
+    final line must always be a parseable compact headline (r4 lost its
+    scoreboard record to a single giant line — BENCH_r04 parsed: null)."""
+
+    def _fat_out(self, bench):
+        # A worst-case detail dict: every headline key present with
+        # realistically wide values, plus kilobytes of non-headline keys.
+        out = {k: 123456.789 for k in bench._HEADLINE_KEYS}
+        out.update({
+            "metric": "gpt2-125m deferred_init→device materialize+touch wall time",
+            "unit": "s",
+            "platform": "tpu (cached hardware measurement; fresh run fell "
+                        "back: cpu(fallback: accelerator backend unreachable "
+                        "after 3 probes))",
+            "train_mfu_error": "x" * 160,
+            "train_mfu_skipped": "accelerator unavailable",
+        })
+        for i in range(200):
+            out[f"padding_key_{i}"] = {"nested": [i] * 8}
+        return out
+
+    def test_headline_fits_budget_and_parses(self, bench):
+        h = bench._headline(self._fat_out(bench), "bench_full.json")
+        line = json.dumps(h)
+        assert len(line) <= bench._HEADLINE_BUDGET
+        parsed = json.loads(line)
+        assert parsed["metric"].startswith("gpt2-125m")
+        assert "vs_baseline" in parsed
+        assert parsed["detail"] == "bench_full.json"
+
+    def test_emit_last_line_is_headline(self, bench, capsys, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "REPO", str(tmp_path))
+        out = self._fat_out(bench)
+        bench._emit(out)
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        assert json.loads(lines[0]) == json.loads((tmp_path / "bench_full.json").read_text())
+        last = json.loads(lines[-1])
+        assert len(lines[-1]) <= bench._HEADLINE_BUDGET
+        assert last["metric"] == out["metric"]
+
+    def test_headline_never_drops_metric_value(self, bench):
+        # Even under an absurd value blow-up the trim loop keeps the
+        # front-of-list keys and stays within budget.
+        out = {k: "y" * 120 for k in bench._HEADLINE_KEYS}
+        h = bench._headline(out, None)
+        assert len(json.dumps(h)) <= bench._HEADLINE_BUDGET
+        assert "metric" in h and "value" in h
