@@ -167,3 +167,67 @@ def test_hbm_oom_propagates():
     q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
     with pytest.raises(RuntimeError, match="in hbm"):
         autotune._measure(boom, q, k, v)
+
+
+def test_vmem_trigger_reports_matched_substring():
+    assert autotune._vmem_trigger(
+        RuntimeError("Scoped allocation with size 9 exceeded scoped vmem limit")
+    ) == "vmem"
+    assert autotune._vmem_trigger(
+        RuntimeError("Scoped allocation with size 9 exceeded the limit")
+    ) == "Scoped allocation"
+    assert autotune._vmem_trigger(
+        RuntimeError("HTTP 500: tpu_compile_helper subprocess exit code 1")
+    ) == "tpu_compile_helper subprocess exit code"
+    assert autotune._vmem_trigger(RuntimeError("connection reset")) is None
+    assert autotune._is_vmem_error(RuntimeError("VMEM overflow"))
+    assert not autotune._is_vmem_error(RuntimeError("RESOURCE_EXHAUSTED: HBM"))
+
+
+class _ScriptedJit:
+    """jax stand-in whose jit ignores the traced fn and returns a
+    scripted g — the only way to make an error first appear in
+    _measure's TIMED loop (a real jit never re-executes Python after
+    the warm-up compile, so a scripted failure can't fire there)."""
+
+    def __init__(self, g):
+        self._g = g
+
+    def jit(self, f):
+        return self._g
+
+
+def test_timed_loop_vmem_error_translates_to_block_config(monkeypatch):
+    import jax.numpy as jnp
+
+    calls = {"n": 0}
+
+    def scripted(carry, n):
+        calls["n"] += 1
+        if calls["n"] > 2:  # both warm-ups succeed; first timed call dies
+            raise RuntimeError(
+                "Scoped allocation with size 123 exceeded scoped vmem limit")
+        return 0.0
+
+    monkeypatch.setattr(autotune, "jax", _ScriptedJit(scripted))
+    q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
+    with pytest.raises(autotune.BlockConfigError):
+        autotune._measure(lambda *c: c, q, k, v)
+    assert calls["n"] == 3
+
+
+def test_timed_loop_non_vmem_error_propagates(monkeypatch):
+    import jax.numpy as jnp
+
+    calls = {"n": 0}
+
+    def scripted(carry, n):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("tunnel reset by peer")
+        return 0.0
+
+    monkeypatch.setattr(autotune, "jax", _ScriptedJit(scripted))
+    q = k = v = jnp.zeros((1, 8, 1, 8), jnp.float32)
+    with pytest.raises(RuntimeError, match="tunnel reset"):
+        autotune._measure(lambda *c: c, q, k, v)

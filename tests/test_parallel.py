@@ -1044,3 +1044,44 @@ class TestUlyssesFlashComposition:
         g_out = jax.jit(jax.grad(loss(attn), argnums=(0, 1, 2)))(q, k, v)
         for gr, go, name in zip(g_ref, g_out, "qkv"):
             assert float(jnp.abs(gr - go).max()) < 1e-4, f"d{name}"
+
+
+class TestGspmd2dPlan:
+    def test_two_largest_dims_take_both_axes(self):
+        from torchdistx_tpu.parallel import gspmd_2d_plan, make_mesh
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        plan = gspmd_2d_plan(min_size=1)
+        # [1024, 64]: fsdp (size 4) on dim 0 (largest), tp (2) on dim 1.
+        assert plan.spec_for("enc.w", (1024, 64), mesh) == P("fsdp", "tp")
+        # 3D: the two largest dims take the axes, smallest stays None.
+        assert plan.spec_for("m.w", (8, 128, 64), mesh) == P(None, "fsdp", "tp")
+
+    def test_indivisible_dim_degrades(self):
+        from torchdistx_tpu.parallel import gspmd_2d_plan, make_mesh
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        plan = gspmd_2d_plan(min_size=1)
+        # dim0 127 not divisible by 4: fsdp falls to dim 1; tp (size 2)
+        # cannot re-use it, and 127 is odd, so tp is dropped.
+        assert plan.spec_for("m.w", (127, 64), mesh) == P(None, "fsdp")
+
+    def test_small_tensor_replicates(self):
+        from torchdistx_tpu.parallel import gspmd_2d_plan, make_mesh
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+        plan = gspmd_2d_plan(min_size=2**16)
+        assert plan.spec_for("m.bias", (64,), mesh) == P()
+
+    def test_size_one_axis_does_not_claim_dims(self):
+        from torchdistx_tpu.parallel import gspmd_2d_plan, make_mesh
+        from jax.sharding import PartitionSpec as P
+
+        # A no-op (size-1) fsdp axis must not block tp from the largest
+        # dim: (65536, 100) on {'fsdp':1,'tp':8} shards dim 0 over tp.
+        mesh = make_mesh({"fsdp": 1, "tp": 8})
+        plan = gspmd_2d_plan(min_size=1)
+        assert plan.spec_for("m.w", (65536, 100), mesh) == P("tp", None)

@@ -207,6 +207,26 @@ def materialize(
     full precision even when materialized as a subtree on their own;
     otherwise every floating leaf is cast.
     """
+    fn, treedef = build_materialize_fn(
+        tree, mesh=mesh, plan=plan, specs=specs, param_dtype=param_dtype
+    )
+    values = fn()
+    return jax.tree.unflatten(treedef, list(values))
+
+
+def build_materialize_fn(
+    tree: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    plan: Optional[ShardingPlan] = None,
+    specs: Optional[Any] = None,
+    param_dtype=None,
+):
+    """The program-construction half of :func:`materialize`: returns
+    ``(jitted_fn, treedef)`` WITHOUT executing.  A login host uses this
+    to ``.lower()`` or ``jax.export`` the complete sharded init program
+    for a pod slice it does not have (the JAX-frontend counterpart of
+    jax_bridge.export's torch-module path)."""
     fakes, treedef = jax.tree.flatten(tree, is_leaf=is_fake)
     for f in fakes:
         if not is_fake(f):
@@ -245,8 +265,7 @@ def materialize(
         fn = jax.jit(run_selected, out_shardings=out_shardings)
     else:
         fn = jax.jit(run_selected)
-    values = fn()
-    return jax.tree.unflatten(treedef, list(values))
+    return fn, treedef
 
 
 def materialize_leaf(

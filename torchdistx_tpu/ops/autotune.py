@@ -112,9 +112,22 @@ def _is_vmem_error(e: BaseException) -> bool:
     recovery (step down, or re-raise when the smallest config also
     fails) is right for any per-config compile crash.  Single source of
     truth for both the autotuner and bench.py's block ladder."""
+    return _vmem_trigger(e) is not None
+
+
+def _vmem_trigger(e: BaseException) -> "Optional[str]":
+    """The substring that classified ``e`` as a vmem-shaped failure, or
+    None.  Exposed separately so demotion sites can RECORD which trigger
+    fired — the helper-subprocess-crash match is deliberately broad
+    (any per-config compile crash), and an audit of published
+    ``vmem_demoted`` numbers needs to see when that broad arm, rather
+    than explicit vmem wording, did the classifying."""
     s = str(e)
-    return any(m in s for m in ("vmem", "VMEM", "Scoped allocation",
-                                "tpu_compile_helper subprocess exit code"))
+    for m in ("vmem", "VMEM", "Scoped allocation",
+              "tpu_compile_helper subprocess exit code"):
+        if m in s:
+            return m
+    return None
 
 
 class BlockConfigError(RuntimeError):
@@ -163,14 +176,23 @@ def _measure(fn, q, k, v, *, extra=(), n_lo=2, n_hi=10, repeats=2) -> float:
             raise BlockConfigError(str(e)) from e
         raise
     deltas = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        float(g(carry, lo))
-        t_lo = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(g(carry, hi))
-        t_hi = time.perf_counter() - t0
-        deltas.append((t_hi - t_lo) / (n_hi - n_lo))
+    try:
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(g(carry, lo))
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(g(carry, hi))
+            t_hi = time.perf_counter() - t0
+            deltas.append((t_hi - t_lo) / (n_hi - n_lo))
+    except Exception as e:
+        # An allocation can trip only under the hi trip count or after
+        # cache effects — a vmem overrun HERE is still a per-config
+        # failure and must reach tune_flash_blocks as BlockConfigError,
+        # not abort the whole tuning run.
+        if _is_vmem_error(e):
+            raise BlockConfigError(str(e)) from e
+        raise
     pos = [d for d in deltas if d > 0]
     return min(pos) if pos else float("inf")
 

@@ -4,7 +4,12 @@ parallel attention/pipeline/MoE building blocks."""
 from .mesh import initialize_multihost, make_hybrid_mesh, make_mesh, single_device_mesh
 from .ring_attention import make_ring_attention
 from .ring_flash import make_ring_flash_attention, ring_flash_attention
-from .sharding import CallableShardingPlan, ShardingPlan, fsdp_plan
+from .sharding import (
+    CallableShardingPlan,
+    ShardingPlan,
+    fsdp_plan,
+    gspmd_2d_plan,
+)
 from .ulysses import make_ulysses_attention
 
 __all__ = [
@@ -15,6 +20,7 @@ __all__ = [
     "ShardingPlan",
     "CallableShardingPlan",
     "fsdp_plan",
+    "gspmd_2d_plan",
     "make_ring_attention",
     "make_ring_flash_attention",
     "make_ulysses_attention",

@@ -160,6 +160,38 @@ def fsdp_plan(axis: str = "fsdp", min_size: int = 2**16) -> "CallableShardingPla
     return CallableShardingPlan(fn)
 
 
+def gspmd_2d_plan(
+    axes: Sequence[str] = ("fsdp", "tp"), min_size: int = 2**16
+) -> "CallableShardingPlan":
+    """Shard the two largest (distinct) dims of every parameter over the
+    2D mesh ``axes`` — the classic GSPMD 2D layout (BASELINE config 4:
+    T5-11B "GSPMD 2D-shard").  A dim takes an axis only if the mesh-axis
+    size divides it; tensors with one eligible dim degrade to 1D over
+    ``axes[0]``, and tensors under ``min_size`` replicate."""
+
+    def fn(name: str, shape: Sequence[int], mesh: Mesh) -> PartitionSpec:
+        if not shape:
+            return PartitionSpec()
+        n = 1
+        for s in shape:
+            n *= s
+        if n < min_size:
+            return PartitionSpec()
+        out = [None] * len(shape)
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for axis in axes:
+            size = mesh.shape.get(axis, 1)
+            if size <= 1:
+                continue  # a no-op axis must not claim a dim from the other
+            for dim in dims:
+                if out[dim] is None and shape[dim] % size == 0:
+                    out[dim] = axis
+                    break
+        return PartitionSpec(*out)
+
+    return CallableShardingPlan(fn)
+
+
 class CallableShardingPlan(ShardingPlan):
     """A plan computed by a function ``(name, shape, mesh) -> PartitionSpec``."""
 
