@@ -1,0 +1,54 @@
+"""Stamp VERSION for a build channel (counterpart of the reference's
+scripts/set-version consumed by its nightly/release pipelines).
+
+Usage:
+    python scripts/set_version.py nightly [YYYYMMDD]
+        0.4.0.dev0 -> 0.4.0.dev20260801  (date defaults to today, UTC)
+    python scripts/set_version.py release
+        0.4.0.dev0 -> 0.4.0              (strip the dev segment)
+    python scripts/set_version.py release 0.5.0
+        write the given version verbatim
+
+The VERSION file is the single source of truth (setup.py reads it), so
+stamping is a one-file edit the packaging jobs run before building.
+"""
+
+from __future__ import annotations
+
+import datetime
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+VERSION_FILE = ROOT / "VERSION"
+_BASE_RE = re.compile(r"^(\d+\.\d+\.\d+)")
+
+
+def stamp(channel: str, arg: str | None = None) -> str:
+    current = VERSION_FILE.read_text().strip()
+    m = _BASE_RE.match(current)
+    if m is None:
+        raise SystemExit(f"VERSION {current!r} lacks a X.Y.Z prefix")
+    base = m.group(1)
+    if channel == "nightly":
+        date = arg or datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%d"
+        )
+        if not re.fullmatch(r"\d{8}", date):
+            raise SystemExit(f"nightly date must be YYYYMMDD, got {date!r}")
+        new = f"{base}.dev{date}"
+    elif channel == "release":
+        new = arg or base
+        if not re.fullmatch(r"\d+\.\d+\.\d+(rc\d+)?", new):
+            raise SystemExit(f"release version must be X.Y.Z[rcN], got {new!r}")
+    else:
+        raise SystemExit(f"unknown channel {channel!r} (nightly|release)")
+    VERSION_FILE.write_text(new + "\n")
+    return new
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    print(stamp(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None))

@@ -97,3 +97,35 @@ class TestAsyncCheckpoint:
         for i in range(3):
             r = restore_checkpoint(tmp_path / f"s{i}", target={"v": jnp.float32(0)})
             assert float(r["v"]) == float(i)
+
+
+class TestVersioning:
+    def test_dunder_version_matches_version_file(self):
+        import pathlib
+
+        import torchdistx_tpu
+
+        vf = (pathlib.Path(torchdistx_tpu.__file__).resolve().parent.parent
+              / "VERSION")
+        assert torchdistx_tpu.__version__ == vf.read_text().strip()
+
+    def test_set_version_stamps(self, monkeypatch, tmp_path):
+        import importlib.util
+        import pathlib
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "set_version", repo / "scripts" / "set_version.py")
+        sv = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sv)
+        vf = tmp_path / "VERSION"
+        vf.write_text("0.4.0.dev0\n")
+        monkeypatch.setattr(sv, "VERSION_FILE", vf)
+        assert sv.stamp("nightly", "20260801") == "0.4.0.dev20260801"
+        assert vf.read_text().strip() == "0.4.0.dev20260801"
+        assert sv.stamp("release") == "0.4.0"
+        assert sv.stamp("release", "0.5.0rc1") == "0.5.0rc1"
+        with pytest.raises(SystemExit):
+            sv.stamp("release", "not-a-version")
+        with pytest.raises(SystemExit):
+            sv.stamp("weekly")

@@ -13,4 +13,26 @@ initialization):
   TPU HBM (``torchdistx_tpu.abstract`` / ``torchdistx_tpu.jax_bridge``).
 """
 
-__version__ = "0.1.0.dev0"
+# Single source of truth is the VERSION file (setup.py reads it; the
+# nightly/release pipelines stamp it via scripts/set_version.py).  An
+# installed package reports its wheel metadata; a source checkout falls
+# back to reading VERSION directly.
+def _read_version() -> str:
+    import pathlib
+
+    # A source checkout answers from VERSION itself — an egg-info left
+    # behind by an earlier build in the same tree can be stale.
+    vf = pathlib.Path(__file__).resolve().parent.parent / "VERSION"
+    try:
+        return vf.read_text().strip()
+    except OSError:
+        pass
+    try:
+        from importlib.metadata import PackageNotFoundError, version
+
+        return version("torchdistx_tpu")
+    except Exception:
+        return "0+unknown"
+
+
+__version__ = _read_version()
