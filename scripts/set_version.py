@@ -9,8 +9,10 @@ Usage:
     python scripts/set_version.py release 0.5.0
         write the given version verbatim
 
-The VERSION file is the single source of truth (setup.py reads it), so
-stamping is a one-file edit the packaging jobs run before building.
+The VERSION file is the single source of truth (setup.py reads it).
+conda has no way to read it at recipe-evaluation time, so
+packaging/conda/meta.yaml duplicates the pin — smoke.sh fails the build
+if the two disagree — and stamping rewrites BOTH files together.
 """
 
 from __future__ import annotations
@@ -22,7 +24,9 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 VERSION_FILE = ROOT / "VERSION"
+CONDA_META = ROOT / "packaging" / "conda" / "meta.yaml"
 _BASE_RE = re.compile(r"^(\d+\.\d+\.\d+)")
+_PIN_RE = re.compile(r'{%\s*set version = "[^"]*"\s*%}')
 
 
 def stamp(channel: str, arg: str | None = None) -> str:
@@ -45,6 +49,14 @@ def stamp(channel: str, arg: str | None = None) -> str:
     else:
         raise SystemExit(f"unknown channel {channel!r} (nightly|release)")
     VERSION_FILE.write_text(new + "\n")
+    if CONDA_META.exists():
+        meta, n = _PIN_RE.subn(f'{{% set version = "{new}" %}}',
+                               CONDA_META.read_text())
+        if n != 1:
+            raise SystemExit(
+                f"{CONDA_META}: expected exactly one version pin, found {n}"
+            )
+        CONDA_META.write_text(meta)
     return new
 
 

@@ -343,3 +343,26 @@ class TestChainTime:
             bench._chain_time(self._jnp(), g, (), 2, 10, repeats=2)
 
 
+
+
+class TestMergeBigLlama:
+    def test_fresh_and_cached_share_key_scheme(self, bench):
+        res = {"t": 12.5, "rss_mb": 2000.0, "n_params": 6738415616,
+               "param_dtype": "bfloat16", "warm": True, "record_s": 0.4,
+               "materialize_s": 11.0, "materialize_gbps": 1.08}
+        out = {}
+        bench._merge_big_llama(out, res)
+        assert out["llama_big_ours_s"] == 12.5
+        assert out["llama_big_param_dtype"] == "bfloat16"
+        assert out["llama_big_materialize_gbps"] == 1.08
+        assert "llama_big_stale_s" not in out
+        out2 = {}
+        bench._merge_big_llama(out2, res, stale_s=777)
+        assert out2["llama_big_stale_s"] == 777
+        assert {k for k in out2 if k != "llama_big_stale_s"} == set(out)
+
+    def test_hw_cache_accepts_big_llama_entry(self, bench):
+        _write(bench, "llama_big_ours", "tpu",
+               {"t": 9.9, "rss_mb": 1500.0, "n_params": 6738415616})
+        got = bench._read_hw_cache("llama_big_ours")
+        assert got is not None and got["result"]["t"] == 9.9

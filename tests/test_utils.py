@@ -121,6 +121,9 @@ class TestVersioning:
         vf = tmp_path / "VERSION"
         vf.write_text("0.4.0.dev0\n")
         monkeypatch.setattr(sv, "VERSION_FILE", vf)
+        meta = tmp_path / "meta.yaml"
+        meta.write_text('{% set version = "0.4.0.dev0" %}\npackage: x\n')
+        monkeypatch.setattr(sv, "CONDA_META", meta)
         assert sv.stamp("nightly", "20260801") == "0.4.0.dev20260801"
         assert vf.read_text().strip() == "0.4.0.dev20260801"
         assert sv.stamp("release") == "0.4.0"
@@ -129,3 +132,6 @@ class TestVersioning:
             sv.stamp("release", "not-a-version")
         with pytest.raises(SystemExit):
             sv.stamp("weekly")
+        # the conda pin is stamped in lockstep (smoke.sh enforces
+        # equality of the two)
+        assert '"0.5.0rc1"' in meta.read_text()

@@ -23,6 +23,7 @@ HW_PHASES = [
     ("gpt2_ours", 900.0),
     ("llama_ours", 900.0),
     ("llama_baseline", 900.0),
+    ("llama_big_ours", 1200.0),
     ("flash", 900.0),
     ("flash_bwd", 900.0),
     ("flash_bias", 900.0),
