@@ -632,6 +632,13 @@ def _flash_phase(mode: str) -> dict:
             autotuned = True
         except Exception:
             pass  # defaults are sound on every kind tested so far
+    forced_blocks = os.environ.get("TDX_FLASH_BLOCKS")
+    if forced_blocks:
+        # Experiment knob (tools/flash_inphase_probe.py): measure THIS
+        # config in the honest chained context instead of the default.
+        # The demotion ladder below still applies from the forced start.
+        bq, bk = _env_ints("TDX_FLASH_BLOCKS", forced_blocks, 2)
+        autotuned = False
     q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
@@ -719,6 +726,7 @@ def _flash_phase(mode: str) -> dict:
         "device_kind": kind,
         "blocks": [bq, bk],
         **({"autotuned": True} if autotuned else {}),
+        **({"blocks_forced": True} if forced_blocks else {}),
         **({"vmem_demoted": True, "demote_reason": demote_reason}
            if demote_reason else {}),
     }

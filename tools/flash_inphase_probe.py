@@ -1,0 +1,82 @@
+"""In-phase flash block-size probe for a live-tunnel window.
+
+The round-4 lesson (docs/benchmarks.md §Block sizes): isolated-kernel
+sweep winners do NOT transfer to the bench's chained `fori_loop`
+context — (2048, 2048) won the standalone forward 2.3× and then hung
+the real phase.  This tool measures candidate blocks IN the phase
+itself (`bench.py --phase flash` with `TDX_FLASH_BLOCKS` forced), each
+config in its own subprocess with a hard timeout, so one hanging
+config cannot eat a capture window.
+
+Run it only on a quiet machine with a healthy tunnel; it prints a
+table plus one JSON line per config, and never touches `.bench_cache/`
+(cache writes happen in bench._run_phase, not in the phase subprocess).
+
+Usage: python tools/flash_inphase_probe.py [fwd|bwd|bias] [timeout_s]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CANDIDATES = {
+    # Ordered cheapest-risk first; the headroom candidates (single
+    # inner k step: no online-softmax rescale loop) come after the
+    # known-good baseline so a wedge mid-run still leaves a comparison.
+    "fwd": [(1024, 1024), (512, 1024), (1024, 2048), (2048, 1024),
+            (2048, 2048)],
+    "bwd": [(1024, 1024), (512, 1024), (1024, 2048), (512, 2048)],
+    "bias": [(512, 1024), (512, 512), (1024, 512)],
+}
+
+
+def probe(mode: str, timeout: float) -> list[dict]:
+    phase = {"fwd": "flash", "bwd": "flash_bwd", "bias": "flash_bias"}[mode]
+    rows = []
+    for bq, bk in CANDIDATES[mode]:
+        env = dict(os.environ, TDX_FLASH_BLOCKS=f"{bq},{bk}")
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--phase", phase],
+                capture_output=True, text=True, cwd=REPO, timeout=timeout,
+                env=env,
+            )
+            if res.returncode != 0:
+                row = {"req": [bq, bk],
+                       "error": (res.stderr or res.stdout).strip()[-200:]}
+            else:
+                row = {"req": [bq, bk],
+                       **json.loads(res.stdout.strip().splitlines()[-1])}
+        except subprocess.TimeoutExpired:
+            row = {"req": [bq, bk], "error": f"TIMEOUT after {timeout:.0f}s"}
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    return rows
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "fwd"
+    timeout = float(sys.argv[2]) if len(sys.argv) > 2 else 420.0
+    rows = probe(mode, timeout)
+    print(f"\n{'requested':>12} {'used':>12} {'ms':>8} {'mfu':>7}  note")
+    for r in rows:
+        used = r.get("blocks", "-")
+        ms = r.get("flash_ms", "-")
+        mfu = r.get("mfu", "-")
+        note = r.get("error", "")[:60] or (
+            "demoted: " + r.get("demote_reason", "")[:48]
+            if r.get("vmem_demoted") else "")
+        print(f"{str(r['req']):>12} {str(used):>12} {str(ms):>8} "
+              f"{str(mfu):>7}  {note}")
+    ok = [r for r in rows if "flash_ms" in r and r.get("backend") != "cpu"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

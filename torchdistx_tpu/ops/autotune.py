@@ -43,7 +43,7 @@ DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
     # genuinely win will still pick them.  A candidate that fails
     # compilation for vmem is skipped (BlockConfigError); if every
     # candidate fails, tuning raises rather than guessing.
-    (2048, 2048), (512, 2048),
+    (2048, 2048), (512, 2048), (1024, 2048),
 )
 
 
