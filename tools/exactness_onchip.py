@@ -1,0 +1,126 @@
+"""On-chip bridge-exactness fuzz (VERDICT r4 weak #4).
+
+The repo's bitwise-replay policy (README §honesty) was proven against
+XLA *CPU* codegen only: every soak ran with the CPU platform forced.
+TPU codegen has its own fusion/fold behavior (and its own matmul
+precision defaults), so this runner streams the SAME oracle programs
+(`tests/test_fuzz_replay._jax_bridge_oracle`) through the real
+accelerator: torch eager on host vs the bridge's XLA program executed
+on the chip, compared bitwise (modulo the documented f64-as-f32
+class).
+
+Each seed's program is structurally unique, so every seed pays a real
+TPU compile through the tunnel — the runner is therefore BUDGETED
+(--seconds) and writes its artifact incrementally after every seed:
+whatever a live-tunnel window yields is committed evidence, and a
+wedge mid-run loses nothing.
+
+    python tools/exactness_onchip.py --seconds 1200 --start 33000000
+
+Artifact: .bench_cache/exactness_tpu.json (ts, platform, device_kind,
+seed range, passed/failed/skipped, failure details).  Exit non-zero on
+any mismatch or if the backend turns out to be CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, ".bench_cache", "exactness_tpu.json")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=1200.0)
+    ap.add_argument("--start", type=int, default=33_000_000)
+    ap.add_argument("--max-seeds", type=int, default=100_000)
+    ap.add_argument("--mode", default="bridge",
+                    choices=("bridge", "geom_bridge"))
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    os.environ.setdefault("OMP_NUM_THREADS", "1")
+    import torch
+
+    torch.set_num_threads(1)
+
+    import jax
+
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    if backend == "cpu" and not os.environ.get("TDX_ONCHIP_ALLOW_CPU"):
+        # TDX_ONCHIP_ALLOW_CPU exists so the runner's own loop/artifact
+        # machinery can be smoke-tested off-chip; such artifacts are
+        # stamped platform=cpu and rejected by _read_hw_cache-style
+        # consumers anyway.
+        print("refusing: default backend is cpu — this runner exists to "
+              "test TPU codegen; use tools/soak.py for CPU soaks")
+        return 2
+
+    import pytest
+
+    import test_fuzz_replay as F
+
+    out = {
+        "ts": time.time(),
+        "platform": backend,
+        "device_kind": kind,
+        "mode": args.mode,
+        "seed_start": args.start,
+        "seeds_run": 0,
+        "passed": 0,
+        "failed": 0,
+        "skipped": 0,
+        "wall_s": 0.0,
+        "failures": [],
+    }
+
+    def flush():
+        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
+        tmp = ARTIFACT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, ARTIFACT)
+
+    t0 = time.time()
+    seed = args.start
+    while (time.time() - t0 < args.seconds
+           and out["seeds_run"] < args.max_seeds):
+        try:
+            F._jax_bridge_oracle(
+                seed, allow_data_ops=True,
+                allow_geom_ops=(args.mode == "geom_bridge"),
+            )
+            out["passed"] += 1
+        except pytest.skip.Exception:
+            out["skipped"] += 1
+        except Exception:
+            out["failed"] += 1
+            out["failures"].append({
+                "seed": seed,
+                "error": traceback.format_exc()[-1500:],
+            })
+        out["seeds_run"] += 1
+        out["wall_s"] = round(time.time() - t0, 1)
+        seed += 1
+        flush()
+        if out["seeds_run"] % 25 == 0:
+            rate = out["seeds_run"] / max(out["wall_s"], 1e-9)
+            print(f"{out['seeds_run']} seeds ({out['passed']} pass / "
+                  f"{out['failed']} fail / {out['skipped']} skip) "
+                  f"{rate:.2f}/s", flush=True)
+
+    flush()
+    print(json.dumps({k: v for k, v in out.items() if k != "failures"}))
+    return 1 if out["failed"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
