@@ -805,7 +805,10 @@ def phase_train_mfu() -> dict:
     tokens = shard_batch(tokens)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
 
-    n_lo, n_hi = _chain_iters("TDX_TRAIN_ITERS", "1,4")
+    # Spread 2,10: differencing 8 steps (not r4's 3) amortizes any
+    # single host hiccup on top of _chain_time's repeat-and-min
+    # (ADVICE r4 #2) — ~36 extra steps per run, well under a minute.
+    n_lo, n_hi = _chain_iters("TDX_TRAIN_ITERS", "2,10")
 
     @jax.jit
     def g(state, n):

@@ -49,3 +49,14 @@ def test_failure_when_every_phase_errors(capture, monkeypatch):
         capture.bench, "_run_phase", lambda name, timeout: {"error": "boom"}
     )
     assert capture.main() == 1
+
+
+def test_never_measured_phases_lead_the_order(capture):
+    # The tunnel window can close mid-list: phases with no prior
+    # hardware entry (train_mfu — the charter metric — and the new
+    # llama_big) must spend the window first; the headline pairs have
+    # round-4 cache entries to fall back on.
+    names = [n for n, _ in capture.HW_PHASES]
+    assert names.index("train_mfu") == 0
+    assert names.index("llama_big_ours") == 1
+    assert names.index("flash") < names.index("gpt2_baseline")

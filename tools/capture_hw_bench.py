@@ -18,16 +18,21 @@ sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
 
+# Ordered by evidence value per minute of tunnel time: phases that have
+# NEVER landed on hardware first (train_mfu is the charter's judging
+# metric; llama_big is the new single-chip scale point), then the flash
+# kernels, then the headline pairs (which already have cached hardware
+# entries from round 4 to fall back on if the window closes mid-list).
 HW_PHASES = [
-    ("gpt2_baseline", 900.0),
-    ("gpt2_ours", 900.0),
-    ("llama_ours", 900.0),
-    ("llama_baseline", 900.0),
+    ("train_mfu", 1500.0),
     ("llama_big_ours", 1200.0),
     ("flash", 900.0),
     ("flash_bwd", 900.0),
     ("flash_bias", 900.0),
-    ("train_mfu", 1500.0),
+    ("gpt2_baseline", 900.0),
+    ("gpt2_ours", 900.0),
+    ("llama_ours", 900.0),
+    ("llama_baseline", 900.0),
 ]
 
 
