@@ -1,0 +1,66 @@
+"""The login-host workflow: record a model's init on a machine with NO
+accelerator, lower + export the fully-sharded init program for a TPU
+pod slice, and ship the artifact.
+
+Runs anywhere (uses a virtual 16-device CPU topology to stand in for
+the slice):
+    python examples/export_login_host.py
+
+Two frontends, same artifact shape:
+
+* torch/HF module → ``jax_bridge.export.export_sharded_init`` (what the
+  ``llama70b_lower`` / ``t5_11b_lower`` bench phases measure at 70B/11B
+  scale);
+* JAX-native model → ``abstract.build_materialize_fn`` + ``jax.export``
+  (the ``mixtral_8x7b_lower`` phase: stacked expert dim sharded over
+  ``ep`` — per-expert placement).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=16"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # a login host has no TPU
+
+import jax.numpy as jnp
+from transformers import LlamaConfig, LlamaForCausalLM
+
+from torchdistx_tpu.deferred_init import deferred_init
+from torchdistx_tpu.jax_bridge.export import export_sharded_init
+from torchdistx_tpu.parallel import gspmd_2d_plan, make_mesh
+
+# -- torch/HF frontend ------------------------------------------------------
+# A small llama stands in for the 70B the bench phase uses; nothing below
+# changes with scale except wall time (seconds) and program size (kB).
+cfg = LlamaConfig(hidden_size=256, intermediate_size=688,
+                  num_hidden_layers=4, num_attention_heads=8,
+                  num_key_value_heads=8, vocab_size=2048)
+m = deferred_init(LlamaForCausalLM, cfg)          # zero storage allocated
+mesh = make_mesh({"fsdp": 8, "tp": 2})
+payload, names = export_sharded_init(
+    m, mesh=mesh, plan=gspmd_2d_plan(min_size=4096), platforms=("tpu",)
+)
+print(f"torch frontend: {len(names)} tensors, "
+      f"{len(payload) / 1e3:.0f} kB TPU artifact")
+
+# -- JAX-native frontend ----------------------------------------------------
+from torchdistx_tpu.abstract import build_materialize_fn
+from torchdistx_tpu.abstract import deferred_init as jx_deferred_init
+from torchdistx_tpu.models import TINY_MOE, decoder_lm_plan, make_mixtral
+
+model = make_mixtral(TINY_MOE)
+fakes = jx_deferred_init(model.init, jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+moe_mesh = make_mesh({"ep": 2, "fsdp": 8})
+jitted, _ = build_materialize_fn(
+    fakes, mesh=moe_mesh, plan=decoder_lm_plan(tp=None)
+)
+exp = jax.export.export(jitted, platforms=["tpu"])()
+print(f"jax frontend: expert-sharded init program, "
+      f"{len(exp.serialize()) / 1e3:.0f} kB, {exp.nr_devices} devices")
+print("ship either artifact to the pod; it runs with zero retracing.")
