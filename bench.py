@@ -88,13 +88,38 @@ def _cache_entries(min_bytes: int = 32768) -> set:
     only get persisted when a loaded host pushes their compile time over
     the persistence threshold — counting those would flap the warm
     stamp run to run)."""
+    d = _effective_cache_dir()
     try:
         return {
-            f for f in os.listdir(CACHE_DIR)
-            if os.path.getsize(os.path.join(CACHE_DIR, f)) >= min_bytes
+            f for f in os.listdir(d)
+            if os.path.getsize(os.path.join(d, f)) >= min_bytes
         }
     except OSError:
         return set()
+
+
+def _host_isa_tag() -> str:
+    """Stable tag for this host's CPU ISA feature set.  XLA:CPU cache
+    entries are AOT machine code compiled for the build host's exact
+    features; loading an entry on a host missing some of them logs
+    'This could lead to execution errors such as SIGILL' (observed live
+    against the committed entries).  Keying the CPU cache directory by
+    ISA makes a mismatched host compile fresh instead of loading
+    foreign machine code."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells it 'flags', aarch64 'Features' — either
+                # way it is the ISA-extension list that decides whether
+                # foreign AOT code can run here.
+                if line.startswith(("flags", "Features")):
+                    import hashlib
+
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha1(flags.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    return "generic"
 
 
 def _init_jax(cache: bool = False):
@@ -108,9 +133,39 @@ def _init_jax(cache: bool = False):
     if plat:
         jax.config.update("jax_platforms", plat)
     if cache:
-        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_compilation_cache_dir", _effective_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     return jax
+
+
+def _effective_cache_dir(backend: str | None = None) -> str:
+    """Where this process's persistent compile cache lives.  Runs whose
+    backend is cpu — forced (virtual-mesh phases, wedged-tunnel
+    fallback) or a silently-failed accelerator plugin — get an
+    ISA-partitioned subdir: XLA:CPU entries are host-specific AOT code
+    (see _host_isa_tag); accelerator entries stay at the root — device
+    kind, not host ISA, keys their validity.  Keyed on the backend jax
+    ACTUALLY initialized, not the env var, so a degraded-plugin run
+    cannot read or write foreign machine code at the root.  The warm
+    stamp (_cache_entries) MUST inspect the same directory."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    if backend == "cpu":
+        return os.path.join(CACHE_DIR, f"cpu-{_host_isa_tag()}")
+    return CACHE_DIR
+
+
+def _virtual_cpu_init(n_devices: int, cache: bool = False):
+    """Shared preamble for virtual-mesh phases: an ``n_devices`` CPU
+    topology, forced CPU platform, jax initialized."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["TDX_BENCH_PLATFORM"] = "cpu"
+    return _init_jax(cache=cache)
 
 
 def _touch(jax, arrays) -> float:
@@ -269,12 +324,7 @@ def _phase_sharded(model_cls, config) -> dict:
     CPU mesh (BASELINE configs 4-5 run on pod slices; the virtual mesh
     proves the same sharded program end-to-end on this single-host
     driver).  Runs in a subprocess with the forced CPU platform."""
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
-    os.environ["TDX_BENCH_PLATFORM"] = "cpu"
-    jax = _init_jax(cache=True)
+    jax = _virtual_cpu_init(8, cache=True)
     from torchdistx_tpu.deferred_init import deferred_init
     from torchdistx_tpu.jax_bridge import materialize_module_jax
     from torchdistx_tpu.parallel import fsdp_plan, make_mesh
@@ -364,15 +414,8 @@ def phase_llama70b_lower() -> dict:
 
 
 def _host64_init() -> None:
-    """Shared preamble for the true-scale host-side phases: a 64-device
-    virtual CPU topology (the pod slice being targeted), forced CPU
-    platform, jax initialized."""
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=64"
-    ).strip()
-    os.environ["TDX_BENCH_PLATFORM"] = "cpu"
-    _init_jax()
+    """True-scale host-side preamble: the 64-device pod-slice topology."""
+    _virtual_cpu_init(64)
 
 
 def _lower_export_tpu(jitted, names, t_record, n_params, *args) -> dict:
@@ -880,6 +923,82 @@ def phase_pp_bubble() -> dict:
     return {"schedule_analysis": out, "backend": "none (static analysis)"}
 
 
+def phase_schedule_measured() -> dict:
+    """MEASURED per-schedule step time — the wall-clock half the static
+    `pp_bubble` analysis cannot give (VERDICT r4 weak #7).  Times the
+    SAME jitted train step under gpipe / flat 1F1B / interleaved
+    (n_chunks=2) on the 8-device virtual CPU mesh (pp=4 × dp=2,
+    8 layers), chain-scheme differenced.  CPU-mesh seconds carry no ICI
+    cost, so the RATIOS are schedule-overhead comparisons on one
+    XLA backend, not TPU predictions — labeled accordingly."""
+    # No persistent cache: a measured phase should compile fresh per
+    # run, and the chain scheme excludes compile time from the
+    # differenced region anyway.
+    jax = _virtual_cpu_init(8)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchdistx_tpu.abstract import deferred_init, materialize
+    from torchdistx_tpu.models import decoder_lm_plan, make_llama
+    from torchdistx_tpu.models.configs import TransformerConfig
+    from torchdistx_tpu.parallel import make_mesh
+    from torchdistx_tpu.parallel.pipeline import pipeline_plan_overrides
+    from torchdistx_tpu.parallel.sharding import ShardingPlan
+    from torchdistx_tpu.parallel.train import make_train_step
+
+    B, S, m = 8, 128, 4
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=8, n_heads=4, d_ff=352,
+        max_seq_len=S,
+        # f32 on the CPU mesh: bf16 + any pipelined schedule aborts
+        # XLA:CPU's compiler (guarded with a clear error in
+        # make_train_step; bf16 pipelines are a TPU path).
+        dtype=jnp.float32,
+    )
+    model = make_llama(cfg)
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    plan = ShardingPlan(
+        pipeline_plan_overrides()
+        + [(p.pattern, s)
+           for p, s in decoder_lm_plan(fsdp=None, ep=None, tp=None).rules]
+    )
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                              cfg.vocab_size)
+    fakes = deferred_init(model.init, jax.random.PRNGKey(0), toks)
+    params = materialize(fakes, mesh=mesh, plan=plan)
+    n_lo, n_hi = _chain_iters("TDX_SCHED_ITERS", "2,6")
+
+    out = {}
+    for label, kw in (
+        ("gpipe", dict(pipeline_schedule="gpipe")),
+        ("flat_1f1b", dict(pipeline_schedule="1f1b")),
+        ("interleaved", dict(pipeline_schedule="interleaved", n_chunks=2)),
+    ):
+        init_state, train_step, shard_batch = make_train_step(
+            model, cfg, mesh, pipeline=True, n_microbatches=m, **kw
+        )
+        state = init_state(params)
+        batch = shard_batch(toks)
+
+        @jax.jit
+        def g(state, n):
+            res = lax.fori_loop(
+                0, n, lambda i, st: train_step(st, batch)[0], state
+            )
+            return jax.tree.leaves(res)[0].sum()
+
+        t = _chain_time(jnp, g, state, n_lo, n_hi)
+        out[f"{label}_step_ms"] = round(t * 1e3, 2)
+    out["interleaved_vs_flat_measured"] = round(
+        out["flat_1f1b_step_ms"] / out["interleaved_step_ms"], 3
+    )
+    out["platform_note"] = (
+        "8-device virtual CPU mesh (pp=4 x dp=2, 8 layers, m=4): "
+        "schedule-overhead ratios on one XLA backend, no ICI cost"
+    )
+    return {"schedule_measured": out, "backend": "cpu"}
+
+
 PHASES = {
     "gpt2_baseline": phase_gpt2_baseline,
     "gpt2_ours": phase_gpt2_ours,
@@ -895,6 +1014,7 @@ PHASES = {
     "flash_bwd": phase_flash_bwd,
     "flash_bias": phase_flash_bias,
     "pp_bubble": phase_pp_bubble,
+    "schedule_measured": phase_schedule_measured,
     "train_mfu": phase_train_mfu,
 }
 
@@ -1339,6 +1459,13 @@ def main() -> None:
         out["schedule_analysis"] = bb.get("schedule_analysis")
     else:
         out["pp_bubble_error"] = bb["error"][-160:]
+
+    sm = _run_phase("schedule_measured", timeout=600.0)
+    sm.pop("_backend", None)  # virtual-mesh phase: backend is cpu by design
+    if "error" not in sm:
+        out["schedule_measured"] = sm.get("schedule_measured")
+    else:
+        out["schedule_measured_error"] = sm["error"][-160:]
 
     if not fallback:
         for name in ("flash", "flash_bwd", "flash_bias"):

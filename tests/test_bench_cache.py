@@ -106,8 +106,10 @@ class TestMergeFlash:
 
 class TestWarmEntryFilter:
     def test_only_substantial_entries_count(self, bench, tmp_path):
-        jax_dir = tmp_path / "jax"
-        jax_dir.mkdir()
+        # _cache_entries inspects the EFFECTIVE dir (this pytest
+        # process's backend is cpu -> the ISA-partitioned subdir).
+        jax_dir = Path(bench._effective_cache_dir())
+        jax_dir.mkdir(parents=True)
         (jax_dir / "tiny").write_bytes(b"x" * 100)
         assert bench._cache_entries() == set()
         (jax_dir / "big").write_bytes(b"x" * 40000)
@@ -366,3 +368,28 @@ class TestMergeBigLlama:
                {"t": 9.9, "rss_mb": 1500.0, "n_params": 6738415616})
         got = bench._read_hw_cache("llama_big_ours")
         assert got is not None and got["result"]["t"] == 9.9
+
+
+class TestEffectiveCacheDir:
+    def test_cpu_backend_partitions_by_isa(self, bench):
+        d = bench._effective_cache_dir("cpu")
+        assert d.startswith(bench.CACHE_DIR)
+        assert "/cpu-" in d.replace("\\", "/")
+        # stable across calls (the warm stamp depends on it)
+        assert bench._effective_cache_dir("cpu") == d
+
+    def test_accelerator_backend_uses_root(self, bench):
+        # Keyed on the backend jax ACTUALLY initialized — a degraded
+        # plugin run (backend cpu, env unset) still partitions.
+        assert bench._effective_cache_dir("tpu") == bench.CACHE_DIR
+        assert bench._effective_cache_dir("cpu") != bench.CACHE_DIR
+
+    def test_warm_stamp_inspects_partitioned_dir(self, bench, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("TDX_BENCH_PLATFORM", "cpu")
+        sub = Path(bench._effective_cache_dir())  # test process backend is cpu
+        sub.mkdir(parents=True)
+        (Path(tmp_path) / "root_entry").write_bytes(b"x" * 40000)
+        assert bench._cache_entries() == set()  # root must NOT count
+        (sub / "cpu_entry").write_bytes(b"x" * 40000)
+        assert bench._cache_entries() == {"cpu_entry"}

@@ -1085,3 +1085,37 @@ class TestGspmd2dPlan:
         mesh = make_mesh({"fsdp": 1, "tp": 8})
         plan = gspmd_2d_plan(min_size=1)
         assert plan.spec_for("m.w", (65536, 100), mesh) == P("tp", None)
+
+
+class TestCpuBf16PipelineGuard:
+    def test_bf16_pipeline_on_cpu_mesh_raises_clearly(self):
+        # bf16 + any pipelined schedule makes XLA:CPU's compiler abort
+        # the whole process (hlo_instruction.cc 'Invalid binary
+        # instruction opcode copy') — make_train_step must refuse with
+        # a catchable error instead.  Cannot be tested by letting it
+        # crash: the abort would kill pytest itself.
+        import dataclasses
+
+        import pytest
+
+        from torchdistx_tpu.models import TINY, make_llama
+        from torchdistx_tpu.parallel import make_mesh
+        from torchdistx_tpu.parallel.train import make_train_step
+
+        cfg = dataclasses.replace(TINY, dtype=jnp.bfloat16)
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        with pytest.raises(RuntimeError, match="XLA:CPU"):
+            make_train_step(make_llama(cfg), cfg, mesh, pipeline=True)
+
+    def test_f32_pipeline_and_bf16_dense_still_build(self):
+        import dataclasses
+
+        from torchdistx_tpu.models import TINY, make_llama
+        from torchdistx_tpu.parallel import make_mesh
+        from torchdistx_tpu.parallel.train import make_train_step
+
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        make_train_step(make_llama(TINY), TINY, mesh, pipeline=True)
+        cfg = dataclasses.replace(TINY, dtype=jnp.bfloat16)
+        dense_mesh = make_mesh({"dp": 8})
+        make_train_step(make_llama(cfg), cfg, dense_mesh)

@@ -9,6 +9,7 @@ scaling-book recipe: pick a mesh, annotate, let XLA insert collectives).
 
 from __future__ import annotations
 
+import os
 import warnings
 from functools import partial
 from typing import Any, Callable, Dict, Optional
@@ -136,6 +137,29 @@ def make_train_step(
         raise ValueError(
             f"pipeline_schedule={pipeline_schedule!r} requires "
             f"pipeline=True (got pipeline=False)."
+        )
+    if (
+        pipeline
+        and mesh.devices.flat[0].platform == "cpu"
+        and jnp.dtype(cfg.dtype) == jnp.dtype(jnp.bfloat16)
+        and not os.environ.get("TDX_ALLOW_CPU_BF16_PIPELINE")
+    ):
+        # XLA's CPU backend aborts the PROCESS compiling any pipelined
+        # schedule with bf16 activations ('Invalid binary instruction
+        # opcode copy', hlo_instruction.cc — reproduced on every
+        # schedule, round 5; f32 pipelines and bf16 dense steps are
+        # both fine).  Raising here turns an uncatchable compiler
+        # abort into a clear error.  TPU meshes are unaffected, and
+        # tracing/lowering WITHOUT an XLA:CPU compile (jit .lower() +
+        # jax.export for TPU from a CPU-only host) is also safe —
+        # TDX_ALLOW_CPU_BF16_PIPELINE=1 opts into that workflow.
+        raise RuntimeError(
+            "pipeline=True with cfg.dtype=bfloat16 on a CPU mesh "
+            "crashes XLA:CPU's compiler (upstream bug). Use "
+            "dtype=jnp.float32 for CPU-mesh runs (tests/virtual "
+            "meshes), or run bf16 pipelines on TPU. If you only "
+            "intend to trace/lower/export (never execute on CPU), "
+            "set TDX_ALLOW_CPU_BF16_PIPELINE=1."
         )
     use_1f1b = pipeline and pipeline_schedule in ("1f1b", "interleaved")
     if use_1f1b and decomp is None:
