@@ -1,0 +1,156 @@
+"""End-to-end wiring tests for bench.main() with the phase-subprocess
+boundary stubbed: the healthy-accelerator branch and the wedged-tunnel
+fallback branch must BOTH end in a compact final stdout line that
+survives the driver's ~2000-char tail capture (round 4 lost its
+scoreboard record to a single giant line — BENCH_r04 parsed: null).
+
+Hermetic: hardware-cache entries are written into the fixture's tmp
+BCACHE_DIR, never read from the committed .bench_cache/.
+"""
+
+import importlib.util
+import io
+import contextlib
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "BCACHE_DIR", str(tmp_path / "bcache"))
+    monkeypatch.setattr(mod, "CACHE_DIR", str(tmp_path / "jax"))
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))  # bench_full.json
+    yield mod
+    sys.modules.pop("bench", None)
+
+
+def _write_hw(bench, name, result, age_s=3600):
+    p = Path(bench.BCACHE_DIR)
+    p.mkdir(parents=True, exist_ok=True)
+    with open(p / f"{name}.json", "w") as f:
+        json.dump({"ts": time.time() - age_s, "platform": "tpu",
+                   "result": result}, f)
+
+
+_HOST_PHASES = {
+    "t5_sharded": {"t": 5.1, "rss_mb": 2287.0, "n_params": 75191808,
+                   "n_sharded": 129, "warm": True, "_backend": "cpu"},
+    "mixtral_sharded": {"t": 4.2, "rss_mb": 1731.0, "n_params": 29763856,
+                        "n_sharded": 114, "warm": True, "_backend": "cpu"},
+    "llama70b_lower": {"record_s": 0.65, "lower_s": 0.45,
+                       "export_tpu_s": 0.43, "export_mb": 0.3,
+                       "n_params": 70553706496, "n_outputs": 724,
+                       "rss_mb": 1219.5},
+    "t5_11b_lower": {"record_s": 0.46, "lower_s": 0.45, "export_tpu_s": 0.44,
+                     "export_mb": 0.22, "n_params": 11307321344,
+                     "n_outputs": 509, "rss_mb": 1216.0},
+    "mixtral_8x7b_lower": {"record_s": 0.79, "lower_s": 1.25,
+                           "export_tpu_s": 1.13, "export_mb": 0.06,
+                           "n_params": 46702792736, "n_outputs": 14,
+                           "rss_mb": 428.6},
+    "pp_bubble": {"schedule_analysis": {"pp4_v2_m8": {"interleaved_ticks": 26}}},
+    "schedule_measured": {"schedule_measured": {
+        "gpipe_step_ms": 1769.0, "flat_1f1b_step_ms": 2509.0,
+        "interleaved_step_ms": 2078.0, "interleaved_vs_flat_measured": 1.208,
+        "platform_note": "8-device virtual CPU mesh"}, "_backend": "cpu"},
+}
+
+
+def _run_main(bench, payloads):
+    def fake_run_phase(name, timeout=600.0, cache_fallback=False):
+        return dict(payloads[name])
+
+    bench._run_phase = fake_run_phase
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    stdout = buf.getvalue()
+    lines = stdout.strip().splitlines()
+    # Simulate the driver: only the last ~2000 chars survive.
+    headline = json.loads(stdout[-2000:].strip().splitlines()[-1])
+    return json.loads(lines[0]), headline, lines
+
+
+def test_healthy_branch_headline_and_detail(bench):
+    payloads = {
+        **_HOST_PHASES,
+        "gpt2_baseline": {"t": 33.1, "rss_mb": 2500.0, "_backend": "tpu"},
+        "gpt2_ours": {"t": 2.7, "rss_mb": 1800.0, "warm": True,
+                      "materialize_gbps": 0.19, "_backend": "tpu"},
+        "llama_ours": {"t": 2.6, "rss_mb": 4100.0, "n_params": 1480000000,
+                       "materialize_gbps": 2.3, "_backend": "tpu"},
+        "llama_baseline": {"t": 266.0, "rss_mb": 9000.0, "_backend": "tpu"},
+        "llama_big_ours": {"t": 14.2, "rss_mb": 2100.0, "warm": True,
+                           "n_params": 6738415616,
+                           "param_dtype": "bfloat16", "record_s": 1.1,
+                           "materialize_s": 12.0, "touch_s": 1.1,
+                           "materialize_gbps": 0.95, "_backend": "tpu"},
+        "flash": {"flash_ms": 0.99, "ref_ms": 4.6, "flash_tflops": 34.9,
+                  "ref_tflops": 7.6, "speedup": 4.64,
+                  "device_kind": "TPU v5 lite", "blocks": [1024, 1024],
+                  "mfu": 0.177, "ref_mfu": 0.038, "_backend": "tpu"},
+        "flash_bwd": {"flash_ms": 3.58, "ref_ms": 13.6, "speedup": 3.79,
+                      "device_kind": "TPU v5 lite", "blocks": [1024, 1024],
+                      "mfu": 0.171, "ref_mfu": 0.045, "_backend": "tpu"},
+        "flash_bias": {"flash_ms": 1.88, "ref_ms": 5.04, "speedup": 2.68,
+                       "device_kind": "TPU v5 lite", "blocks": [512, 1024],
+                       "mfu": 0.186, "ref_mfu": 0.069, "_backend": "tpu"},
+        "train_mfu": {"step_ms": 185.0, "tokens_per_s": 44300, "mfu": 0.31,
+                      "device_kind": "TPU v5 lite", "n_params": 124000000,
+                      "_backend": "tpu"},
+    }
+    bench._preflight_platform = lambda: ""
+    full, headline, lines = _run_main(bench, payloads)
+    assert len(lines) == 2
+    assert len(lines[-1]) <= bench._HEADLINE_BUDGET
+    assert headline["vs_baseline"] == round(33.1 / 2.7, 3)
+    assert headline["train_mfu"] == 0.31
+    assert headline["flash_mfu"] == 0.177
+    assert headline["llama_big_n_params"] == 6738415616
+    assert headline["llama_big_materialize_gbps"] == 0.95
+    assert headline["t5_11b_n_params"] == 11307321344
+    assert headline["mixtral_8x7b_rss_mb"] == 428.6
+    assert full["llama_1p9b_vs_baseline"] == round(266.0 / 2.6, 3)
+    assert full["llama_big_param_dtype"] == "bfloat16"
+    assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208
+    assert json.load(open(Path(bench.REPO) / "bench_full.json")) == full
+
+
+def test_fallback_branch_promotes_cached_hardware(bench):
+    # Committed-hardware-cache stand-ins in the hermetic tmp dir.
+    _write_hw(bench, "gpt2_ours", {"t": 2.7, "rss_mb": 1800.0,
+                                   "materialize_gbps": 0.19})
+    _write_hw(bench, "gpt2_baseline", {"t": 33.1, "rss_mb": 2500.0})
+    _write_hw(bench, "flash", {"flash_ms": 0.985, "speedup": 4.59,
+                               "mfu": 0.177})
+    payloads = {
+        **_HOST_PHASES,
+        "gpt2_baseline": {"t": 400.0, "rss_mb": 2500.0, "_backend": "cpu"},
+        "gpt2_ours": {"t": 60.0, "rss_mb": 1800.0, "warm": False,
+                      "materialize_gbps": 0.008, "_backend": "cpu"},
+    }
+    bench._preflight_platform = (
+        lambda: "cpu(fallback: accelerator backend unreachable)")
+    full, headline, lines = _run_main(bench, payloads)
+    assert headline["headline_from_cache"] is True
+    assert headline["vs_baseline"] == round(33.1 / 2.7, 3)
+    assert 3500 <= headline["headline_age_s"] <= 3700
+    assert full["cpu_fresh_vs_baseline"] == round(400.0 / 60.0, 3)
+    assert full["flash_skipped"] == "accelerator unavailable"
+    assert full["flash_ms"] == 0.985 and full["flash_stale_s"] > 0
+    # No cached train_mfu / llama_big entries: skipped markers, nothing
+    # fabricated.
+    assert full["train_mfu_skipped"] == "accelerator unavailable"
+    assert "train_mfu" not in full
+    assert full["llama_big_skipped"] == "accelerator unavailable"
+    assert "llama_big_ours_s" not in full
