@@ -841,7 +841,15 @@ def phase_train_mfu() -> dict:
         vocab_size=32000, d_model=d, n_layers=L, n_heads=H, d_ff=d_ff,
         max_seq_len=S, remat=remat,
     )
-    attn = make_flash_attention()
+    # TDX_TRAIN_FLASH_BLOCKS=bq,bk feeds a probe-confirmed flash config
+    # into the charter metric's attention (tools/flash_inphase_probe.py
+    # finds candidates; only in-phase-confirmed winners belong here).
+    tb = os.environ.get("TDX_TRAIN_FLASH_BLOCKS")
+    if tb:
+        tbq, tbk = _env_ints("TDX_TRAIN_FLASH_BLOCKS", tb, 2)
+        attn = make_flash_attention(block_q=tbq, block_k=tbk)
+    else:
+        attn = make_flash_attention()
     model = make_llama(cfg, attn_fn=attn)
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
     tokens = jax.random.randint(
