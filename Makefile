@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke docs clean
+.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -62,6 +62,16 @@ docs:
 	else \
 		echo "docs build skipped: sphinx/myst-parser not installed (CI runs it)"; \
 	fi
+
+# Run every example end-to-end (each forces its own virtual CPU mesh;
+# no accelerator needed).  Nightly CI runs this so the examples cannot
+# rot against the library surface.
+examples:
+	@set -e; for ex in examples/*.py; do \
+		echo "== $$ex"; \
+		PYTHONPATH=. python "$$ex" > /tmp/tdx_ex.log 2>&1 \
+		    || { tail -40 /tmp/tdx_ex.log; exit 1; }; \
+	done; echo "all examples OK"
 
 clean:
 	rm -rf csrc/build torchdistx_tpu/_lib
