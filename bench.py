@@ -48,6 +48,7 @@ import os
 import resource
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -1052,14 +1053,39 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
     the headline, explicitly labeled (headline_from_cache, ages, and the
     fresh CPU pair preserved under cpu_fresh_*)."""
     err = None
+    res = None
+    # NOT subprocess.run(timeout=.., capture_output=True): run() kills
+    # only the direct child on timeout and then blocks draining the
+    # captured pipes — which axon backend-init helpers inherit and can
+    # hold open even past a SUCCESSFUL child's exit (the _probe
+    # docstring's deadlock; a live round-5 train_mfu timeout left such
+    # helpers alive).  run_in_killable_group is the shared hang-proof
+    # recipe: own session, file-backed stdio (no EOF needed to read
+    # back), process-group kill on timeout and success alike.
+    from torchdistx_tpu._probe import run_in_killable_group
+
+    argv = [sys.executable, os.path.abspath(__file__), "--phase", name]
+    out_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
+                                   errors="replace")
+    err_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
+                                   errors="replace")
     try:
-        res = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--phase", name],
-            capture_output=True, text=True, cwd=REPO, timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        err = {"error": f"phase {name} timed out after {timeout:.0f}s",
-               "timeout_s": timeout}
+        rc = run_in_killable_group(argv, timeout, stdout=out_f,
+                                   stderr=err_f, cwd=REPO)
+        if rc is None:
+            err = {"error": f"phase {name} timed out after {timeout:.0f}s",
+                   "timeout_s": timeout}
+        else:
+            out_f.seek(0)
+            err_f.seek(0)
+            res = subprocess.CompletedProcess(
+                argv, rc, out_f.read(), err_f.read()
+            )
+    except (OSError, subprocess.SubprocessError) as e:
+        err = {"error": f"phase {name} failed to spawn: {e}"}
+    finally:
+        out_f.close()
+        err_f.close()
     if err is None and res.returncode != 0:
         err = {"error": (res.stderr or res.stdout).strip()[-400:]}
     if err is None:
@@ -1195,21 +1221,30 @@ def _preflight_platform() -> str:
     if os.environ.get("TDX_BENCH_PLATFORM"):
         return ""  # user forced a platform explicitly: not a fallback
     sys.path.insert(0, REPO)
-    from torchdistx_tpu._probe import probe_device_count
+    from torchdistx_tpu._probe import probe_compute_ok, probe_device_count
 
     # The tunnel wedges transiently; each probe is a FRESH subprocess
     # (probe_device_count spawns one per call), so retry with backoff
-    # before surrendering the round to CPU.  Worst case ~11 min — small
-    # against the cost of a scoreboard with no hardware numbers.
+    # before surrendering the round to CPU.  Worst case ~23 min (3 x
+    # (180 s + 240 s) + 2 x 60 s sleep) — small against the cost of a
+    # scoreboard with no hardware numbers.
+    #
+    # Enumeration alone is NOT health: the tunnel has a wedge mode where
+    # jax.devices() answers in seconds while every compile hangs
+    # (observed live, round 5 — see probe_compute_ok).  Passing the gate
+    # in that mode costs the full per-phase timeout budget, 600-1500 s a
+    # phase, so the extra <=240 s compute probe is cheap insurance.
     attempts = int(os.environ.get("TDX_BENCH_PROBE_ATTEMPTS", "3"))
     for i in range(attempts):
-        if probe_device_count(timeout=180.0) > 0:
+        if probe_device_count(timeout=180.0) > 0 and probe_compute_ok(
+            timeout=240.0
+        ):
             return ""  # default platform is healthy
         if i + 1 < attempts:
             time.sleep(60.0)
     os.environ["TDX_BENCH_PLATFORM"] = "cpu"
     return (
-        f"cpu(fallback: accelerator backend unreachable "
+        f"cpu(fallback: accelerator backend unreachable or compile-wedged "
         f"after {attempts} probes)"
     )
 

@@ -1,0 +1,44 @@
+"""Hang-proof backend probing (torchdistx_tpu/_probe.py).
+
+The probe layer is what stands between a capture window and a wedged
+axon tunnel (reference has nothing comparable — its CI never faces a
+remote accelerator; see SURVEY.md §6).  Two independent failure axes
+are covered: enumeration (``jax.devices()`` hangs) and compilation
+(devices answer but every compile hangs — the round-5 live-session
+wedge mode that motivated ``probe_compute_ok``).
+"""
+
+from __future__ import annotations
+
+from torchdistx_tpu._probe import (
+    _probe,
+    probe_compute_ok,
+    probe_device_count,
+)
+
+
+def test_device_count_on_cpu():
+    # platform="cpu" is load-bearing: the axon plugin ignores the
+    # inherited JAX_PLATFORMS=cpu (conftest.py:17-21), so an unpinned
+    # probe subprocess would probe the tunnel — and hang against a
+    # wedged one — instead of the 8-device virtual CPU mesh the
+    # inherited XLA_FLAGS describe.
+    assert probe_device_count(timeout=300.0, platform="cpu") == 8
+
+
+def test_compute_ok_on_cpu():
+    assert probe_compute_ok(timeout=300.0, platform="cpu") is True
+
+
+def test_probe_timeout_yields_zero():
+    # A program that never writes its result file must come back 0 —
+    # and come back promptly (killpg, not wait-for-child-exit).
+    assert _probe("import time; time.sleep(600)  # {path!r}", 2.0) == 0
+
+
+def test_probe_crash_yields_zero():
+    assert _probe("raise RuntimeError({path!r})", 60.0) == 0
+
+
+def test_probe_garbage_result_yields_zero():
+    assert _probe("open({path!r}, 'w').write('not-an-int')", 60.0) == 0

@@ -16,7 +16,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from torchdistx_tpu._probe import probe_device_count  # noqa: E402
+from torchdistx_tpu._probe import probe_compute_ok, probe_device_count  # noqa: E402
 
 
 def main() -> None:
@@ -24,9 +24,15 @@ def main() -> None:
     captures = 0
     while True:
         n = probe_device_count(timeout=120.0)
-        print(f"[tpu_watch] {time.strftime('%H:%M:%S')} devices={n}",
-              flush=True)
-        if n > 0:
+        # Enumeration alone is not health: the axon tunnel has a wedge
+        # mode where jax.devices() answers in seconds but every compile
+        # hangs (observed live, round 5).  Only a probe that compiles
+        # AND executes a program proves a capture window is real; the
+        # two-stage check keeps the cheap probe as the fast-path skip.
+        ok = n > 0 and probe_compute_ok(timeout=240.0)
+        print(f"[tpu_watch] {time.strftime('%H:%M:%S')} devices={n} "
+              f"compute_ok={ok}", flush=True)
+        if ok:
             rc = subprocess.run(
                 [sys.executable, os.path.join(REPO, "tools", "capture_hw_bench.py")],
                 cwd=REPO,

@@ -19,28 +19,107 @@ import sys
 import tempfile
 
 
-def probe_device_count(timeout: float = 150.0) -> int:
+def probe_device_count(timeout: float = 150.0,
+                       platform: str | None = None) -> int:
     """Number of jax devices the default backend exposes, or 0 if the
-    backend is unreachable (hangs, crashes, or cannot spawn)."""
+    backend is unreachable (hangs, crashes, or cannot spawn).
+
+    ``platform`` pins the probed backend through the config API inside
+    the subprocess — the only forcing that binds on this image (the
+    axon plugin initializes regardless of an inherited
+    ``JAX_PLATFORMS=cpu``, so an env-only override still probes — and
+    hangs with — the tunnel).  None probes the default backend, which
+    is the production question."""
+    return _probe(
+        _force(platform) +
+        "import jax; "
+        "open({path!r}, 'w').write(str(len(jax.devices())))",
+        timeout,
+    )
+
+
+def probe_compute_ok(timeout: float = 240.0,
+                     platform: str | None = None) -> bool:
+    """Can the default backend actually COMPILE AND EXECUTE a program
+    right now?  Device enumeration and compilation fail independently on
+    the axon tunnel: a round-5 live session saw ``jax.devices()`` answer
+    in seconds while a 256x256 matmul hung past 180 s (the remote
+    compile helper was wedged; enumeration never touches it).  Gating a
+    capture window on :func:`probe_device_count` alone therefore burns
+    the window's entire per-phase timeout budget against a backend that
+    cannot run anything — this probe is the stronger precondition.
+
+    The probe program is deliberately trivial (one tiny jitted matmul)
+    so a healthy-but-cold tunnel passes well inside the default budget:
+    enumeration ~10 s, trivial compile ~20-40 s cold.  Same
+    subprocess/file/killpg discipline as above; False on timeout, crash,
+    or a result that is not finite."""
+    return _probe(
+        _force(platform) +
+        "import jax, jax.numpy as jnp, math; "
+        "x = jnp.ones((256, 256), jnp.bfloat16); "
+        "v = float((x @ x).sum()); "
+        "open({path!r}, 'w').write('1' if math.isfinite(v) else '0')",
+        timeout,
+    ) == 1
+
+
+def _force(platform: str | None) -> str:
+    if platform is None:
+        return ""
+    if not platform.isidentifier():  # goes into generated code
+        raise ValueError(f"platform is not a bare identifier: {platform!r}")
+    return (
+        "import jax; "
+        f"jax.config.update('jax_platforms', '{platform}'); "
+    )
+
+
+def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
+                          cwd: "str | None" = None) -> "int | None":
+    """THE hang-proof subprocess recipe, shared by every caller that has
+    to survive a wedged backend (this module's probes, bench._run_phase):
+    spawn ``argv`` in its OWN session, wait at most ``timeout``, and
+    process-group-kill on timeout — AND after a successful exit, because
+    axon backend-init helpers outlive even a successful child (observed
+    live, round 5) holding inherited fds and tunnel connections.
+
+    ``stdout``/``stderr`` accept real file objects (no EOF needed to
+    read back — pipes would deadlock on a helper that keeps the write
+    end open) or None for DEVNULL.  Returns the child's returncode, or
+    None on timeout.  Spawn failures propagate (OSError /
+    SubprocessError) — what they mean is caller-specific."""
+    proc = subprocess.Popen(
+        argv,
+        stdout=stdout if stdout is not None else subprocess.DEVNULL,
+        stderr=stderr if stderr is not None else subprocess.DEVNULL,
+        start_new_session=True,
+        cwd=cwd,
+    )
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        if timed_out:
+            try:
+                proc.kill()
+            except (OSError, ProcessLookupError):
+                pass
+    proc.wait()
+    return None if timed_out else proc.returncode
+
+
+def _probe(code_tmpl: str, timeout: float) -> int:
     fd, path = tempfile.mkstemp(prefix="tdx_probe_")
     os.close(fd)
-    code = (
-        "import jax; "
-        f"open({path!r}, 'w').write(str(len(jax.devices())))"
-    )
+    code = code_tmpl.format(path=path)
     try:
         try:
-            proc = subprocess.Popen(
-                [sys.executable, "-c", code],
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-                start_new_session=True,
-            )
-            try:
-                proc.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                os.killpg(proc.pid, signal.SIGKILL)
-                proc.wait()
+            run_in_killable_group([sys.executable, "-c", code], timeout)
         except (OSError, subprocess.SubprocessError):
             return 0
         try:
