@@ -1,0 +1,117 @@
+"""Wishlist sequencing of the capture watch loop (tools/tpu_watch.py).
+
+Pure control-flow tests — probes and tool launches are stubbed, no
+backend is touched.  What matters: evidence-value ordering, the
+failure-attempt cap (a deterministically-failing item must not eat
+every healthy window), and termination after a full refresh pass.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_watch():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", os.path.join(REPO, "tools", "tpu_watch.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _drive(monkeypatch, mod, rcs):
+    """Run main() with healthy probes, recording tool launches; ``rcs``
+    maps item name -> list of successive return codes."""
+    launches = []
+
+    def fake_run(name, tail, timeout):
+        launches.append(name)
+        seq = rcs.get(name, [0])
+        return seq.pop(0) if seq else 0
+
+    monkeypatch.setattr(mod, "probe_device_count", lambda timeout: 1)
+    monkeypatch.setattr(mod, "probe_compute_ok", lambda timeout: True)
+    monkeypatch.setattr(mod, "_run", fake_run)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    mod.main()
+    return launches
+
+
+def test_wishlist_order_and_refresh(monkeypatch):
+    mod = _load_watch()
+    launches = _drive(monkeypatch, mod, {})
+    # One full pass in evidence order, then a second refresh pass.
+    assert launches == ["capture", "exactness", "flash_probe"] * 2
+
+
+def test_failing_item_capped_not_starving(monkeypatch):
+    mod = _load_watch()
+    # capture fails MAX_ATTEMPTS times: the first pass must move on to
+    # the rest of the wishlist instead of starving it, and the partial
+    # pass must NOT count toward termination — two further full passes
+    # are required.
+    launches = _drive(monkeypatch, mod, {"capture": [1] * mod.MAX_ATTEMPTS})
+    assert launches == (
+        ["capture"] * mod.MAX_ATTEMPTS + ["exactness", "flash_probe"]
+        + ["capture", "exactness", "flash_probe"] * 2
+    )
+
+
+def test_total_failure_never_terminates(monkeypatch):
+    mod = _load_watch()
+
+    class StillWatching(Exception):
+        pass
+
+    sleeps = {"n": 0}
+
+    def counting_sleep(s):
+        sleeps["n"] += 1
+        if sleeps["n"] > 8:  # well past two exhausted passes
+            raise StillWatching
+
+    monkeypatch.setattr(mod, "probe_device_count", lambda timeout: 1)
+    monkeypatch.setattr(mod, "probe_compute_ok", lambda timeout: True)
+    monkeypatch.setattr(mod, "_run", lambda name, tail, timeout: 1)
+    monkeypatch.setattr(mod.time, "sleep", counting_sleep)
+    try:
+        mod.main()
+    except StillWatching:
+        pass  # the loop was still watching — correct
+    else:
+        raise AssertionError(
+            "main() returned despite zero successful wishlist items"
+        )
+
+
+def test_timeout_counts_as_attempt(monkeypatch):
+    mod = _load_watch()
+    launches = _drive(monkeypatch, mod, {"exactness": [None, 0]})
+    # The timed-out (None) run consumed an attempt, then succeeded.
+    assert launches[:4] == ["capture", "exactness", "exactness",
+                            "flash_probe"]
+
+
+def test_wishlist_paths_exist():
+    mod = _load_watch()
+    for _, tail, _ in mod.WISHLIST:
+        assert os.path.exists(os.path.join(REPO, tail[0])), tail[0]
+
+
+def test_sys_executable_argv(monkeypatch):
+    mod = _load_watch()
+    captured = {}
+
+    def fake_killable(argv, timeout, stdout=None, stderr=None, cwd=None):
+        captured["argv"] = argv
+        return 0
+
+    monkeypatch.setattr(mod, "run_in_killable_group", fake_killable)
+    assert mod._run("capture", ["tools/capture_hw_bench.py"], 5.0) == 0
+    assert captured["argv"][0] == sys.executable
+    assert captured["argv"][1].endswith("tools/capture_hw_bench.py")
