@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -41,6 +41,25 @@ leak-check:
 
 test:
 	python -m pytest tests/ -q
+
+# One lint entry point for CI and humans (rule set lives in ruff.toml).
+# Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,
+# CI installs it and fails loudly.
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	elif python -c "import ruff" 2>/dev/null; then \
+		python -m ruff check .; \
+	else \
+		echo "lint skipped: ruff not installed (CI runs it)"; \
+	fi
+
+# Digest a telemetry trace directory (see docs/observability.md): top
+# spans by self-time, compile-cache hit ratio, platform-fallback count.
+# TDX_TRACE_DIR defaults to ./traces for symmetry with the env knob that
+# produces the files.
+trace-summary:
+	python tools/tdx_trace.py summary $${TDX_TRACE_DIR:-traces}
 
 # Build a wheel bundling the native engine (reference parity: its
 # setup.py install_cmake wheel flow; setup.py itself runs `make native`).

@@ -52,6 +52,16 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+if REPO not in sys.path:  # `python /abs/path/bench.py` from another cwd
+    sys.path.insert(0, REPO)
+
+# Stdlib-only telemetry (no torch/jax at import): every phase emits spans
+# and provenance events through the shared tracer, so with TDX_TRACE_DIR
+# set a bench round leaves a Perfetto-loadable trace whose cached-vs-fresh
+# / platform-fallback story is structured events, not ad-hoc strings
+# (summarize with tools/tdx_trace.py).  No-ops when telemetry is off.
+from torchdistx_tpu import observe  # noqa: E402
+
 CACHE_DIR = os.path.join(REPO, ".jax_cache")
 
 
@@ -59,28 +69,12 @@ def _rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
-# Dense bf16 peak TFLOP/s per chip, by device kind substring.  Source:
-# public TPU spec sheets (per-chip, not per-core).  Used to turn achieved
-# TFLOP/s into MFU; unknown kinds simply omit the MFU field rather than
-# guess.
-_PEAK_TFLOPS = [
-    ("v6", 918.0),  # Trillium
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),
-    ("v5e", 197.0),
-    ("v5litepod", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
 def _peak_tflops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_TFLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """Dense bf16 peak TFLOP/s per chip; the table lives with the rest of
+    the telemetry (observe.step.PEAK_TFLOPS) so bench MFU and the train
+    loop's mfu_est gauge can never disagree.  Unknown kinds return None —
+    MFU is omitted, not guessed."""
+    return observe.peak_tflops_for(device_kind)
 
 
 def _cache_entries(min_bytes: int = 32768) -> set:
@@ -216,17 +210,25 @@ def _phase_ours(model_cls, config, param_dtype=None) -> dict:
     before = _cache_entries()
     jax.devices()
     t0 = time.perf_counter()
-    m = deferred_init(model_cls, config)
+    with observe.span("bench.record", category="bench"):
+        m = deferred_init(model_cls, config)
     t_record = time.perf_counter() - t0
-    params = materialize_module_jax(m, seed=0, **kw)
+    with observe.span("bench.materialize", category="bench") as _sp:
+        params = materialize_module_jax(m, seed=0, **kw)
+        _sp.block_on(params)
     jax.block_until_ready(params)
     t_mat = time.perf_counter() - t0 - t_record
-    _touch(jax, params.values())
+    with observe.span("bench.touch", category="bench"):
+        _touch(jax, params.values())
     t = time.perf_counter() - t0
     # Warm = the run actually HIT: entries existed and none were added
     # (a cold compile writes its entry; a shipped-but-mismatched cache
     # must not be stamped warm just for existing).
     warm = bool(before) and _cache_entries() == before
+    observe.instant(
+        "bench.cache_provenance", category="bench",
+        warm=warm, backend=jax.default_backend(),
+    )
     n_bytes = sum(int(v.size) * v.dtype.itemsize for v in params.values())
     return {
         "t": t,
@@ -1052,6 +1054,13 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
     measured on CPU, main() may PROMOTE the last cached hardware pair to
     the headline, explicitly labeled (headline_from_cache, ages, and the
     fresh CPU pair preserved under cpu_fresh_*)."""
+    with observe.span(
+        "bench.phase", category="bench", phase=name, timeout_s=timeout
+    ) as _sp:
+        return _run_phase_inner(name, timeout, cache_fallback, _sp)
+
+
+def _run_phase_inner(name: str, timeout: float, cache_fallback: bool, _sp):
     err = None
     res = None
     # NOT subprocess.run(timeout=.., capture_output=True): run() kills
@@ -1122,13 +1131,23 @@ def _run_phase(name: str, timeout: float = 600.0, cache_fallback: bool = False):
             # Returned to main() so live-reported numbers can be labeled
             # or suppressed when a phase silently ran on CPU.
             parsed["_backend"] = backend
+        _sp.set(outcome="fresh", backend=backend)
         return parsed
     if cache_fallback:
         cached = _read_hw_cache(name)
         if cached is not None:
+            stale = round(time.time() - cached["ts"])
+            _sp.set(outcome="cached", stale_s=stale)
+            observe.instant(
+                "bench.cache_fallback", category="bench", phase=name,
+                stale_s=stale, error=err["error"][-120:],
+            )
+            if observe.enabled():
+                observe.counter("tdx.bench.cache_fallback").inc()
             return {**cached["result"],
-                    "stale_s": round(time.time() - cached["ts"]),
+                    "stale_s": stale,
                     "fresh_run_error": err["error"][-160:]}
+    _sp.set(outcome="error", error=err["error"][-120:])
     return err
 
 
@@ -1243,6 +1262,13 @@ def _preflight_platform() -> str:
         if i + 1 < attempts:
             time.sleep(60.0)
     os.environ["TDX_BENCH_PLATFORM"] = "cpu"
+    if observe.enabled():
+        observe.counter("tdx.bench.platform_fallback").inc()
+    observe.instant(
+        "bench.platform_fallback", category="bench",
+        reason="accelerator unreachable or compile-wedged",
+        attempts=attempts,
+    )
     return (
         f"cpu(fallback: accelerator backend unreachable or compile-wedged "
         f"after {attempts} probes)"
@@ -1602,6 +1628,7 @@ def _emit(out: dict) -> None:
         detail_file = None
     print(full)
     print(json.dumps(_headline(out, detail_file)))
+    observe.flush()  # trace/metrics files when TDX_TRACE_DIR etc. are set
 
 
 if __name__ == "__main__":

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Summarize / merge torchdistx_tpu telemetry traces.
+
+Traces are the Chrome-trace JSON files `torchdistx_tpu.observe` flushes
+into ``TDX_TRACE_DIR`` (one per process — bench phases each run in their
+own subprocess, so a bench round leaves several).  Stdlib only: usable on
+a login host with no torch/jax installed.
+
+Commands:
+
+``summary <dir-or-file>... [--top N]``
+    Human-readable digest of one run: wall span, top span names by
+    aggregate self-time, compile-cache hit ratio, platform-fallback and
+    verification-failure counts, final counter/gauge values.
+
+``chrome <dir-or-file>... [-o merged.json]``
+    Merge every per-process trace into ONE Chrome-trace JSON loadable in
+    ``chrome://tracing`` / Perfetto (timestamps are epoch-anchored, so
+    processes land on a shared timeline).
+
+Exit status: 0 on success, 2 when no trace events were found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterator, List
+
+
+def iter_trace_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.endswith(".trace.json") or name.endswith(".json"):
+                    yield os.path.join(p, name)
+        else:
+            yield p
+
+
+def load_events(paths: List[str]) -> List[dict]:
+    events: List[dict] = []
+    for path in iter_trace_files(paths):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+        if isinstance(evs, list):
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def _final_counters(events: List[dict]) -> Dict[str, float]:
+    """Counters are per-process cumulative totals: take the LATEST sample
+    (by timestamp — file order is not time order across flushes) of each
+    (name, pid) stream, then sum over pids so a multi-process run
+    aggregates correctly."""
+    last: Dict[tuple, tuple] = {}
+    for e in events:
+        if e.get("ph") != "C":
+            continue
+        args = e.get("args") or {}
+        value = args.get("value")
+        if value is None and "count" in args:  # histogram snapshot
+            value = args.get("count")
+        if value is None:
+            continue
+        key = (e.get("name"), e.get("pid"))
+        ts = float(e.get("ts", 0.0))
+        if key not in last or ts >= last[key][0]:
+            last[key] = (ts, float(value))
+    out: Dict[str, float] = {}
+    for (name, _pid), (_ts, v) in last.items():
+        out[name] = out.get(name, 0.0) + v
+    return out
+
+
+def summarize(events: List[dict], top: int = 15) -> str:
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    counters = _final_counters(events)
+    lines: List[str] = []
+
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+        pids = {e.get("pid") for e in spans}
+        lines.append(
+            f"{len(spans)} spans across {len(pids)} process(es), "
+            f"wall {((t1 - t0) / 1e6):.3f} s"
+        )
+        agg: Dict[str, List[float]] = {}
+        for e in spans:
+            args = e.get("args") or {}
+            self_us = args.get("self_us", e.get("dur", 0.0))
+            agg.setdefault(e["name"], [0.0, 0.0, 0.0])
+            a = agg[e["name"]]
+            a[0] += 1
+            a[1] += e.get("dur", 0.0)
+            a[2] += self_us
+        lines.append("")
+        lines.append(f"top spans by aggregate self-time (of {len(agg)}):")
+        lines.append(f"  {'name':<28} {'count':>5} {'total_s':>9} {'self_s':>9}")
+        ranked = sorted(agg.items(), key=lambda kv: -kv[1][2])[:top]
+        for name, (n, tot, self_t) in ranked:
+            lines.append(
+                f"  {name:<28} {int(n):>5} {tot / 1e6:>9.3f} {self_t / 1e6:>9.3f}"
+            )
+    else:
+        lines.append("no spans found")
+
+    hits = counters.get("tdx.jax.compile_cache_hit", 0.0)
+    misses = counters.get("tdx.jax.compile_cache_miss", 0.0)
+    uncached = counters.get("tdx.jax.compile_cache_uncached", 0.0)
+    lines.append("")
+    if hits or misses or uncached:
+        denom = hits + misses
+        ratio = f"{hits / denom:.0%}" if denom else "n/a"
+        lines.append(
+            f"compile cache: {int(hits)} hit / {int(misses)} miss "
+            f"({ratio} hit ratio)"
+            + (f", {int(uncached)} uncached" if uncached else "")
+        )
+    else:
+        lines.append("compile cache: no compile events recorded")
+
+    # Counter preferred; the instant events are the same occurrences
+    # (counting both would double), and only the exact platform event
+    # qualifies — bench.cache_fallback is a different condition.
+    fallbacks = counters.get("tdx.bench.platform_fallback")
+    if fallbacks is None:
+        fallbacks = sum(
+            1 for e in instants
+            if e.get("name") == "bench.platform_fallback"
+        )
+    lines.append(f"platform fallbacks: {int(fallbacks)}")
+    verify = sum(
+        v for k, v in counters.items()
+        if k.startswith("tdx.graph.verify_failures")
+    )
+    if verify:
+        lines.append(f"replay verification failures: {int(verify)}")
+
+    interesting = {
+        k: v for k, v in sorted(counters.items())
+        if not k.startswith("tdx.jax.compile_cache")
+    }
+    if interesting:
+        lines.append("")
+        lines.append("counters/gauges (final values, summed over processes):")
+        for k, v in interesting.items():
+            vs = f"{int(v)}" if v == int(v) else f"{v:.3f}"
+            lines.append(f"  {k:<36} {vs}")
+    return "\n".join(lines)
+
+
+def merge_chrome(events: List[dict]) -> dict:
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tdx_trace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("summary", help="digest a trace dir/file")
+    ps.add_argument("paths", nargs="+")
+    ps.add_argument("--top", type=int, default=15)
+    pc = sub.add_parser("chrome", help="merge into one Chrome-trace JSON")
+    pc.add_argument("paths", nargs="+")
+    pc.add_argument("-o", "--output", default=None,
+                    help="output file (default: stdout)")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.paths)
+    if not events:
+        print("no trace events found", file=sys.stderr)
+        return 2
+    if args.cmd == "summary":
+        print(summarize(events, top=args.top))
+    else:
+        doc = merge_chrome(events)
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(doc, f)
+                f.write("\n")
+            print(f"wrote {args.output} ({len(events)} events)")
+        else:
+            json.dump(doc, sys.stdout)
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `tdx_trace ... | head` is a normal usage
+        sys.exit(0)

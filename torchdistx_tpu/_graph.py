@@ -33,11 +33,14 @@ from __future__ import annotations
 import contextlib
 import gc
 import threading
+import time
 import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import torch
+
+from . import observe
 
 from .fake import (
     FakeTensor,
@@ -67,6 +70,7 @@ _op_counter = itertools.count()
 _gc_pause_lock = threading.Lock()
 _gc_pause_depth = 0
 _gc_disabled_by_us = False
+_gc_pause_t0 = 0.0
 
 
 @contextlib.contextmanager
@@ -75,9 +79,11 @@ def gc_paused():
     replay, bridge interpretation); exception-safe, re-entrant, and
     thread-shared.  Allocation-triggered collections resume at exit and
     reap the region's actual garbage then."""
-    global _gc_pause_depth, _gc_disabled_by_us
+    global _gc_pause_depth, _gc_disabled_by_us, _gc_pause_t0
     with _gc_pause_lock:
         _gc_pause_depth += 1
+        if _gc_pause_depth == 1:
+            _gc_pause_t0 = time.perf_counter()
         # Checked on EVERY entry, not just the 0->1 transition: if the
         # outermost region found GC already off (flag stays False) and
         # other code re-enabled it mid-region, a nested entry re-arms
@@ -90,9 +96,17 @@ def gc_paused():
     finally:
         with _gc_pause_lock:
             _gc_pause_depth -= 1
-            if _gc_pause_depth == 0 and _gc_disabled_by_us:
+            last_out = _gc_pause_depth == 0
+            # Read under the lock: another thread entering a fresh pause
+            # after we release would overwrite the shared start stamp.
+            pause_t0 = _gc_pause_t0
+            if last_out and _gc_disabled_by_us:
                 _gc_disabled_by_us = False
                 gc.enable()
+        if last_out and observe.enabled():
+            observe.histogram("tdx.graph.gc_pause_s").observe(
+                time.perf_counter() - pause_t0
+            )
 
 
 def _next_op_nr() -> int:
@@ -509,6 +523,8 @@ class OpNode:
                     break
                 nodes.append(n)
             if ok:
+                if observe.enabled():
+                    observe.counter("tdx.graph.nodes_walked").inc(len(nodes))
                 return nodes
         last = self.last_in_place_node()
         included: Dict[int, OpNode] = {}
@@ -605,6 +621,8 @@ class OpNode:
                                 visit(reader)
                                 changed = True
         stack = sorted(included.values(), key=lambda n: n.op_nr)
+        if observe.enabled():
+            observe.counter("tdx.graph.nodes_walked").inc(len(stack))
         return stack
 
     def detach_dependencies(self) -> None:
@@ -737,6 +755,7 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
     # Outputs: assign contexts; tensor outputs are indexed by position among
     # tensor outputs (Op::getOutput, deferred_init.cc:270-297).
     tensor_idx = 0
+    fakes_created = 0
     for t in _iter_tensors(out):
         if is_fake(t):
             skey = _storage_key(t._meta)
@@ -757,6 +776,7 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
             else:
                 ctx = DeferredInitContext(node, tensor_idx)
                 set_fake_context(t, CONTEXT_KEY, ctx)
+                fakes_created += 1
             # View keep-alive: output aliases an input's storage → retain
             # the output's context on the base input's context
             # (deferred_init.cc:427-458).
@@ -766,6 +786,12 @@ def record_op(func, args, kwargs, out, *, name: Optional[str] = None) -> None:
         tensor_idx += 1
 
     node._native_sync_edges()
+
+    if observe.enabled():
+        reg = observe.counters()
+        reg.counter("tdx.graph.ops_recorded").inc()
+        if fakes_created:
+            reg.counter("tdx.graph.fakes_created").inc(fakes_created)
 
 
 # ---------------------------------------------------------------------------
@@ -882,11 +908,13 @@ def _verify_external_args(node: OpNode) -> None:
     # materializeArguments' external checks (deferred_init.cc:636-663).
     for t, version in node.argument_versions:
         if version is None or t.is_inference():
+            _count_verify_failure(node, "inference_tensor")
             raise RuntimeError(
                 f"The tensor argument of `{node.op.name}` is an inference "
                 f"tensor and cannot be used for deferred initialization."
             )
         if t._version != version:
+            _count_verify_failure(node, "external_version")
             raise RuntimeError(
                 f"A tensor argument of `{node.op.name}` was modified in "
                 f"place after it was recorded; the recording can no longer "
@@ -894,6 +922,15 @@ def _verify_external_args(node: OpNode) -> None:
                 f"(see docs/deferred_init.md, and the reference's identical "
                 f"constraint, deferred_init.cc:643-651)."
             )
+
+
+def _count_verify_failure(node: OpNode, kind: str) -> None:
+    if observe.enabled():
+        observe.counter("tdx.graph.verify_failures", kind=kind).inc()
+        observe.instant(
+            "graph.verify_failure", category="graph",
+            op=node.op.name, op_nr=node.op_nr, kind=kind,
+        )
 
 
 def replay_node(node: OpNode, target: ReplayTarget) -> None:
@@ -925,6 +962,8 @@ def replay_node(node: OpNode, target: ReplayTarget) -> None:
     if node._ng is not None:
         node._ng.set_materialized(node._nid, True)
     node.detach_dependencies()
+    if observe.enabled():
+        observe.counter("tdx.graph.nodes_replayed").inc()
 
 
 def materialize_graph(node: OpNode, target: ReplayTarget) -> None:

@@ -17,6 +17,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 
 def probe_device_count(timeout: float = 150.0,
@@ -33,7 +34,7 @@ def probe_device_count(timeout: float = 150.0,
     return _probe(
         _force(platform) +
         "import jax; "
-        "open({path!r}, 'w').write(str(len(jax.devices())))",
+        "open(__PATH__, 'w').write(str(len(jax.devices())))",
         timeout,
     )
 
@@ -59,7 +60,7 @@ def probe_compute_ok(timeout: float = 240.0,
         "import jax, jax.numpy as jnp, math; "
         "x = jnp.ones((256, 256), jnp.bfloat16); "
         "v = float((x @ x).sum()); "
-        "open({path!r}, 'w').write('1' if math.isfinite(v) else '0')",
+        "open(__PATH__, 'w').write('1' if math.isfinite(v) else '0')",
         timeout,
     ) == 1
 
@@ -76,7 +77,8 @@ def _force(platform: str | None) -> str:
 
 
 def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
-                          cwd: "str | None" = None) -> "int | None":
+                          cwd: "str | None" = None,
+                          reap_grace: float = 10.0) -> "int | None":
     """THE hang-proof subprocess recipe, shared by every caller that has
     to survive a wedged backend (this module's probes, bench._run_phase):
     spawn ``argv`` in its OWN session, wait at most ``timeout``, and
@@ -84,10 +86,21 @@ def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
     axon backend-init helpers outlive even a successful child (observed
     live, round 5) holding inherited fds and tunnel connections.
 
+    The child's exit is observed with ``os.waitid(..., WNOWAIT)`` — the
+    zombie is left unreaped until AFTER the killpg, so the pid (and with
+    it the process-group id) stays pinned and the SIGKILL cannot land on
+    a recycled pid/pgid from an unrelated process (ADVICE r5 finding 1;
+    the old ``Popen.wait`` reaped first and then killed by number).
+
+    The final reap is bounded by ``reap_grace`` seconds: a hang-proof
+    wrapper must not itself hang, so if the child cannot be reaped after
+    the group kill (e.g. wedged in an uninterruptible state) we give up
+    and report None rather than block forever (ADVICE r5 finding 3).
+
     ``stdout``/``stderr`` accept real file objects (no EOF needed to
     read back — pipes would deadlock on a helper that keeps the write
     end open) or None for DEVNULL.  Returns the child's returncode, or
-    None on timeout.  Spawn failures propagate (OSError /
+    None on timeout or failed reap.  Spawn failures propagate (OSError /
     SubprocessError) — what they mean is caller-specific."""
     proc = subprocess.Popen(
         argv,
@@ -96,27 +109,58 @@ def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
         start_new_session=True,
         cwd=cwd,
     )
-    timed_out = False
-    try:
-        proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        timed_out = True
+    timed_out = not _wait_exited_unreaped(proc.pid, timeout)
+    # Whether the child exited (now a zombie — still pinning the pgid) or
+    # is still running, the group id is valid: kill every helper in it.
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except (OSError, ProcessLookupError):
-        if timed_out:
-            try:
-                proc.kill()
-            except (OSError, ProcessLookupError):
-                pass
-    proc.wait()
+        try:
+            proc.kill()
+        except (OSError, ProcessLookupError):
+            pass
+    try:
+        proc.wait(timeout=reap_grace)
+    except subprocess.TimeoutExpired:
+        return None  # unreapable child: report failure, do not hang
     return None if timed_out else proc.returncode
 
 
+def _wait_exited_unreaped(pid: int, timeout: float) -> bool:
+    """Block until ``pid`` exits or ``timeout`` expires, WITHOUT reaping:
+    ``WNOWAIT`` leaves the zombie in place, so the pid/pgid cannot be
+    recycled before the caller's ``killpg``.  Returns True if the exit
+    was observed.  Polling (WNOHANG) rather than a blocking waitid keeps
+    the timeout exact without signals/threads."""
+    deadline = time.monotonic() + timeout
+    delay = 0.005
+    while True:
+        try:
+            res = os.waitid(
+                os.P_PID, pid, os.WEXITED | os.WNOWAIT | os.WNOHANG
+            )
+        except ChildProcessError:
+            return True  # already reaped elsewhere; nothing left to pin
+        if res is not None:
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2, 0.25)
+
+
 def _probe(code_tmpl: str, timeout: float) -> int:
+    """Run a probe template, reading its integer result from a temp file.
+
+    The template marks where the result-file path goes with a literal
+    ``__PATH__`` token (substituted with the ``repr`` of the path), NOT
+    ``str.format`` — a future template containing braces (f-strings,
+    dict literals) would make ``format`` raise or corrupt the generated
+    code (ADVICE r5 finding 2)."""
     fd, path = tempfile.mkstemp(prefix="tdx_probe_")
     os.close(fd)
-    code = code_tmpl.format(path=path)
+    code = code_tmpl.replace("__PATH__", repr(path))
     try:
         try:
             run_in_killable_group([sys.executable, "-c", code], timeout)

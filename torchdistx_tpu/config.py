@@ -22,7 +22,20 @@ Environment variables (read at first import):
                         jax bridge (compile-time control; see
                         jax_bridge/ops.py).
 ``TDX_LOG_LEVEL``       Logging level name for the framework logger.
+``TDX_TRACE_DIR``       Directory for runtime telemetry traces: when set,
+                        :mod:`torchdistx_tpu.observe` collects spans across
+                        record/compile/materialize/train and flushes a
+                        Chrome-trace JSON file (Perfetto-loadable) there at
+                        process exit ("" disables).
+``TDX_METRICS_PATH``    File for the telemetry counter registry: Prometheus
+                        text format if the path ends in ``.prom``, JSON
+                        lines otherwise ("" disables).
 ======================  ====================================================
+
+Per-scope telemetry works like every other knob::
+
+    with tdx_config.override(trace_dir="/tmp/traces"):
+        materialize_module_jax(m)   # spans + counters collected
 """
 
 from __future__ import annotations
@@ -42,6 +55,8 @@ class Config:
     cache_dir: Optional[str] = None
     rng_chunk_elems: int = 1 << 20
     log_level: str = "INFO"
+    trace_dir: Optional[str] = None
+    metrics_path: Optional[str] = None
 
 
 def _from_env() -> Config:
@@ -51,6 +66,8 @@ def _from_env() -> Config:
         cache_dir=cache or None,
         rng_chunk_elems=int(os.environ.get("TDX_RNG_CHUNK", str(1 << 20))),
         log_level=os.environ.get("TDX_LOG_LEVEL", "INFO"),
+        trace_dir=os.environ.get("TDX_TRACE_DIR", "") or None,
+        metrics_path=os.environ.get("TDX_METRICS_PATH", "") or None,
     )
 
 

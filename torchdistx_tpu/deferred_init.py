@@ -33,7 +33,7 @@ import torch
 from torch.nn import Module, Parameter
 from torch.utils._python_dispatch import TorchDispatchMode
 
-from . import _graph
+from . import _graph, observe
 from ._graph import CONTEXT_KEY, ReplayTarget, record_op
 from .fake import ModeToggle, _fake_handler, _iter_tensors, _tree_map, is_fake, is_param_like
 
@@ -187,7 +187,10 @@ def deferred_init(module_fn: Callable[..., Any], *args: Any, **kwargs: Any):
 
     Reference: deferred_init.py:17-36.
     """
-    with _deferred():
+    with observe.span(
+        "record", category="record",
+        fn=getattr(module_fn, "__name__", type(module_fn).__name__),
+    ), _deferred():
         try:
             return module_fn(*args, **kwargs)
         except RuntimeError as e:
@@ -279,7 +282,10 @@ def materialize_module(
         # construction bitwise (_graph.materialize_many), then recurse
         # with the shared memo — all under one GC pause (replay allocates
         # like recording does; see _graph.gc_paused).
-        with _graph.gc_paused():
+        with observe.span(
+            "torch.materialize_module", category="record",
+            module=type(module).__name__, buffers_only=buffers_only,
+        ), _graph.gc_paused():
             fakes = []
 
             def collect(mod):

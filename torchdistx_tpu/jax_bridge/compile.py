@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import torch
 
+from .. import observe
 from .._graph import CONTEXT_KEY, OpNode, get_fake_context
 from ..fake import FakeTensor
 from ._dtypes import to_numpy
@@ -620,7 +621,15 @@ def build_init_fn(
     O(unique structures) instead of O(depth); results are bitwise
     identical either way.
     """
+    with observe.span(
+        "bridge.build_init_fn", category="jax", n_outputs=len(fakes)
+    ) as _sp:
+        return _build_init_fn(fakes, dedup=dedup, _sp=_sp)
+
+
+def _build_init_fn(fakes, *, dedup, _sp):
     nodes = collect_nodes(fakes)
+    _sp.set(n_nodes=len(nodes), dedup=dedup)
     slots = []
     for f in fakes:
         c = get_fake_context(f, CONTEXT_KEY)
@@ -680,6 +689,16 @@ def build_init_fn(
         insts = groups[sig]
         if len(insts) > 1 and needed.get(sig) and group_rng[sig]:
             scan_buckets.setdefault(len(insts), []).append(sig)
+
+    if observe.enabled():  # aggregation itself is O(groups); skip when off
+        _sp.set(
+            n_components=sum(len(g) for g in groups.values()),
+            n_unique_structures=len(groups),
+            n_batched_groups=sum(
+                1 for sig in group_order
+                if len(groups[sig]) > 1 and needed.get(sig)
+            ),
+        )
 
     def _interp_rep(sig, knr_vec, base_key):
         """Interpret the representative of ``sig`` with instance key

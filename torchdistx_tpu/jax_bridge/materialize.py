@@ -11,12 +11,15 @@ host RSS stays O(largest metadata), not O(model size).
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Dict, Iterator, Optional, Tuple
 
 import jax
 import torch
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import observe
 from .._graph import gc_paused
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
@@ -59,7 +62,9 @@ def _compiler_options() -> Optional[dict]:
                     compiler_options={key: value}
                 )
                 accepted[key] = value
+                outcome = "accepted"
             except Exception:
+                outcome = "rejected"
                 if key == "xla_allow_excess_precision":
                     import warnings
 
@@ -69,6 +74,17 @@ def _compiler_options() -> Optional[dict]:
                         "intermediates, losing bitwise parity with torch "
                         "replay."
                     )
+            if observe.enabled():
+                # Probed once per process; the outcome is provenance a
+                # trace reader needs (a backend silently dropping the
+                # parity knob changes what the numbers mean).
+                observe.counter(
+                    f"tdx.jax.compiler_option_{outcome}", option=key
+                ).inc()
+                observe.instant(
+                    "jax.compiler_option_probe", category="jax",
+                    option=key, outcome=outcome,
+                )
         _options_supported = accepted
     return _options_supported or None
 
@@ -88,7 +104,24 @@ def _maybe_enable_cache() -> None:
     cache_dir = config.get().cache_dir
     if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        # TDX_CACHE_MIN_COMPILE_S=0 persists even trivial programs —
+        # tests use it to exercise the compile-cache hit/miss telemetry
+        # deterministically with toy models.
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get("TDX_CACHE_MIN_COMPILE_S", "0.1")),
+        )
+        # jax memoizes a once-per-process "cache used?" decision at the
+        # FIRST compile; any compile before this point (even the
+        # PRNGKey seed computation) latches it to "unused" and every
+        # later materialize silently skips the cache.  reset_cache()
+        # un-latches so the dir set above actually binds.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
         _cache_enabled = True
 
 
@@ -119,6 +152,20 @@ def _cast_outputs(init_fn, param_dtype, mask=None):
     return fn
 
 
+def _persistent_cache_entries() -> Optional[set]:
+    """Filenames in jax's persistent compilation cache dir, or None when
+    no cache is configured.  Differencing before/after a compile is the
+    hit/miss oracle (same technique bench.py's warm stamp uses): a MISS
+    writes its entry, a HIT writes nothing."""
+    d = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not d:
+        return None
+    try:
+        return set(os.listdir(d))
+    except OSError:
+        return set()
+
+
 def _run_init(init_fn, key, out_shardings=None):
     _maybe_enable_cache()
     if out_shardings is not None:
@@ -126,9 +173,38 @@ def _run_init(init_fn, key, out_shardings=None):
     else:
         jitted = jax.jit(init_fn)
     opts = _compiler_options()
-    if opts is None:
-        return jitted(key)
-    return jitted.lower(key).compile(compiler_options=opts)(key)
+    if not observe.enabled():
+        if opts is None:
+            return jitted(key)
+        return jitted.lower(key).compile(compiler_options=opts)(key)
+    # Instrumented path: the same lower→compile→execute pipeline, staged
+    # explicitly so each phase gets its own span and the compile-cache
+    # outcome is counted per program.
+    with observe.span("jax.lower", category="jax"):
+        lowered = jitted.lower(key)
+    before = _persistent_cache_entries()
+    with observe.span("jax.compile", category="jax") as csp:
+        compiled = (
+            lowered.compile(compiler_options=opts)
+            if opts is not None else lowered.compile()
+        )
+        after = _persistent_cache_entries()
+        if before is None:
+            outcome = "uncached"  # no persistent cache dir configured
+        elif after != before:
+            outcome = "miss"
+        elif before:
+            outcome = "hit"
+        else:
+            # Empty cache cannot hit; the entry was just too fast/small
+            # to persist (same boundary bench.py's warm stamp documents).
+            outcome = "miss"
+        csp.set(cache=outcome)
+        observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
+    with observe.span("jax.execute", category="jax") as esp:
+        out = compiled(key)
+        esp.block_on(out)
+    return out
 
 
 def named_fake_tensors(module: torch.nn.Module) -> Dict[str, torch.Tensor]:
@@ -199,12 +275,26 @@ def materialize_params_jax(
     """
     # Tracing/interpreting the graph allocates like recording does
     # (Box/lens objects, jaxpr eqns); same GC pause, same rationale.
-    with gc_paused():
+    t0 = time.perf_counter()
+    with observe.span(
+        "jax.materialize", category="jax", n_outputs=len(fakes),
+        backend=jax.default_backend() if observe.enabled() else None,
+    ) as sp, gc_paused():
         names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
         if param_dtype is not None:
             mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
             init_fn = _cast_outputs(init_fn, param_dtype, mask)
         values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
+        if observe.enabled():
+            # _run_init's execute span already blocked, so this is a
+            # bookkeeping pass, not a second sync.
+            jax.block_until_ready(values)
+            n_bytes = sum(int(v.size) * v.dtype.itemsize for v in values)
+            dt = time.perf_counter() - t0
+            gbps = n_bytes / dt / 1e9  # unrounded: toy models are ~1e-6
+            sp.set(bytes=n_bytes, gbps=gbps)
+            observe.counter("tdx.jax.bytes_materialized").inc(n_bytes)
+            observe.gauge("tdx.jax.materialize_gbps").set(gbps)
     return dict(zip(names, values))
 
 
@@ -268,7 +358,9 @@ def lower_init_module(
         mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
         init_fn = _cast_outputs(init_fn, param_dtype, mask)
     jitted = jax.jit(init_fn, out_shardings=out_shardings)
-    return jitted.lower(jax.random.PRNGKey(0)), names
+    with observe.span("jax.lower", category="jax", n_outputs=len(names)):
+        lowered = jitted.lower(jax.random.PRNGKey(0))
+    return lowered, names
 
 
 def materialize_module_jax(

@@ -1,0 +1,274 @@
+"""Span tracer: nested, thread-safe, wall-clock + block-until-ready aware.
+
+Spans absorb what ``utils.profiling.Timer`` measured (wall time with an
+optional ``block_on`` so async device dispatch cannot lie) and add what it
+could not: nesting (per-thread span stack, self-time precomputed at close),
+a process-wide event log, and Chrome-trace export loadable in
+``chrome://tracing`` / Perfetto.
+
+Timestamps are epoch-anchored microseconds measured on the monotonic clock
+(``perf_counter`` delta from an import-time epoch pairing), so traces from
+several processes of one run — bench phases each run in a subprocess —
+merge into a coherent timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# In-memory event cap: a run that records but never flushes (or flushes
+# only metrics) must not grow memory without bound — oldest events are
+# dropped and the drop count is stamped into the export.
+_MAX_EVENTS = 200_000
+
+_EPOCH0 = time.time()
+_PERF0 = time.perf_counter()
+
+
+def now_us() -> float:
+    """Epoch-anchored monotonic timestamp in microseconds."""
+    return (_EPOCH0 + (time.perf_counter() - _PERF0)) * 1e6
+
+
+class Span:
+    """One traced region.  Use via ``observe.span(...)`` as a context
+    manager; ``set(**attrs)`` attaches arguments, ``block_on(value)``
+    makes the close wait for async device work."""
+
+    __slots__ = (
+        "name", "category", "args", "t0_us", "dur_us",
+        "_tracer", "_child_us", "_blocked", "_entered",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, category: str,
+                 args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.category = category
+        self.args: Dict[str, Any] = dict(args) if args else {}
+        self.t0_us = 0.0
+        self.dur_us: Optional[float] = None
+        self._tracer = tracer
+        self._child_us = 0.0
+        self._blocked: Any = None
+        self._entered = False
+
+    def set(self, **attrs) -> "Span":
+        self.args.update(attrs)
+        return self
+
+    def block_on(self, value):
+        """Make ``__exit__`` wait for ``value``'s async device work before
+        stamping the duration (``jax.block_until_ready``)."""
+        self._blocked = value
+        return value
+
+    @property
+    def elapsed(self) -> Optional[float]:
+        """Seconds, once closed (``utils.profiling.Timer`` compat)."""
+        return None if self.dur_us is None else self.dur_us / 1e6
+
+    def __enter__(self) -> "Span":
+        self._entered = True
+        self._tracer._push(self)
+        self.t0_us = now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._blocked is not None:
+            import jax  # lazy: the tracer itself is dependency-free
+
+            jax.block_until_ready(self._blocked)
+            self._blocked = None  # don't pin device arrays past the scope
+        self.dur_us = now_us() - self.t0_us
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self._tracer._pop(self)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned when telemetry is disabled — call
+    sites keep one code path and pay only the ``enabled()`` check."""
+
+    __slots__ = ()
+    elapsed = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def block_on(self, value):
+        return value
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Thread-safe process-wide span/event log with Chrome-trace export."""
+
+    def __init__(self, max_events: int = _MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self.dropped = 0
+        self.events: "deque[dict]" = deque(maxlen=max_events)
+
+    # -- recording -------------------------------------------------------
+
+    def span(self, name: str, category: str = "tdx",
+             args: Optional[Dict[str, Any]] = None) -> Span:
+        return Span(self, name, category, args)
+
+    def instant(self, name: str, category: str = "tdx",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        self._record({
+            "name": name, "cat": category, "ph": "i", "s": "t",
+            "ts": now_us(), "pid": _pid(), "tid": _tid(),
+            **({"args": dict(args)} if args else {}),
+        })
+
+    def counter_sample(self, name: str, value: float) -> None:
+        """A Chrome-trace counter ('C') sample — gauges call this on every
+        ``set`` so they graph as time series in the trace viewer."""
+        self._record({
+            "name": name, "ph": "C", "ts": now_us(), "pid": _pid(),
+            "tid": _tid(), "args": {"value": value},
+        })
+
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+            if stack:
+                # Parent self-time = dur - children; precomputed here so
+                # the summary CLI needs no containment analysis.
+                stack[-1]._child_us += span.dur_us
+        elif stack and span in stack:  # unwound out of order (generators)
+            stack.remove(span)
+        args = dict(span.args)
+        args["self_us"] = round(max(0.0, span.dur_us - span._child_us), 1)
+        self._record({
+            "name": span.name, "cat": span.category, "ph": "X",
+            "ts": span.t0_us, "dur": span.dur_us, "pid": _pid(),
+            "tid": _tid(), "args": args,
+        })
+
+    def _record(self, event: dict) -> None:
+        with self._lock:
+            if (
+                self.events.maxlen is not None
+                and len(self.events) == self.events.maxlen
+            ):
+                self.dropped += 1  # deque evicts the oldest on append
+            self.events.append(event)
+
+    # -- export ----------------------------------------------------------
+
+    def drain(self) -> List[dict]:
+        """Atomically take (and clear) the recorded events — the one
+        correct way to flush without losing spans recorded concurrently
+        between a copy and a separate clear."""
+        with self._lock:
+            events = list(self.events)
+            self.events.clear()
+            return events
+
+    def chrome_events(self, counters=None,
+                      events: Optional[List[dict]] = None) -> List[dict]:
+        """The Chrome-trace ``traceEvents`` list: recorded events (or the
+        explicit ``events`` — e.g. a :meth:`drain` result) plus, if a
+        registry is given, one final 'C' sample per counter/gauge and a
+        metadata record naming the process."""
+        if events is None:
+            with self._lock:
+                out = list(self.events)
+        else:
+            out = list(events)
+        ts = now_us()
+        if counters is not None:
+            for rec in counters.snapshot():
+                if rec["type"] == "histogram":
+                    args = {"count": rec["count"], "sum": rec["sum"]}
+                else:
+                    args = {"value": rec["value"]}
+                labels = rec.get("labels")
+                # Label sets become distinct counter names: two kinds of
+                # verify_failures must not collide into one last-write
+                # sample in the trace (and the summary CLI aggregates
+                # them back by name prefix).
+                name = rec["name"] + (
+                    "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+                    if labels else ""
+                )
+                out.append({
+                    "name": name, "ph": "C", "ts": ts,
+                    "pid": _pid(), "tid": 0, "args": args,
+                })
+        out.append({
+            "name": "process_name", "ph": "M", "pid": _pid(), "tid": 0,
+            "args": {"name": f"torchdistx_tpu pid={_pid()}"},
+        })
+        with self._lock:
+            dropped = self.dropped
+        if dropped:
+            out.append({
+                "name": "tdx.trace.events_dropped", "ph": "C", "ts": ts,
+                "pid": _pid(), "tid": 0, "args": {"value": dropped},
+            })
+        return out
+
+    def export_chrome(self, path: str, counters=None,
+                      events: Optional[List[dict]] = None) -> None:
+        """Write a Chrome-trace JSON object (Perfetto-loadable)."""
+        doc = {
+            "traceEvents": self.chrome_events(counters, events=events),
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+
+    def export_jsonl(self, path: str) -> None:
+        """Append the raw event log as JSON lines (one event per line)."""
+        with self._lock:
+            events = list(self.events)
+        with open(path, "a") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    def flush_seq(self) -> int:
+        """Monotone per-process sequence number for flush file names."""
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self.dropped = 0
+
+
+def _pid() -> int:
+    import os
+
+    return os.getpid()
+
+
+def _tid() -> int:
+    return threading.get_ident() & 0x7FFFFFFF  # chrome wants small-ish ints

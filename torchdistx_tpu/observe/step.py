@@ -1,0 +1,116 @@
+"""Per-step training telemetry: :class:`StepMeter`, the successor of
+``utils.profiling.StepTimer``.
+
+Each step becomes a ``train.step`` span (block-until-ready aware, so async
+dispatch cannot hide device time) and feeds derived throughput gauges:
+``tdx.train.tokens_per_s`` and — when FLOPs and a peak are known —
+``tdx.train.mfu_est``.  ``parallel.train.make_train_step`` wires one of
+these around the jitted step automatically when telemetry is enabled.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+# Dense bf16 peak TFLOP/s per chip, by device-kind substring (public TPU
+# spec sheets, per chip).  Unknown kinds return None — derived MFU is
+# omitted rather than guessed.  bench.py delegates here so the table has
+# one home.
+PEAK_TFLOPS = (
+    ("v6", 918.0),  # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def peak_tflops_for(device_kind: str) -> Optional[float]:
+    """Peak dense-bf16 TFLOP/s for a jax ``device_kind`` string, or None
+    when the kind is unknown (callers must omit MFU, not guess)."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+class StepMeter:
+    """Running throughput stats for a training loop, with per-step spans.
+
+    Drop-in for ``StepTimer`` (``start`` / ``stop`` / ``steps`` / ``total``
+    / ``mean``), plus:
+
+    * each ``start``/``stop`` pair records a span (default ``train.step``)
+      when telemetry is enabled;
+    * ``tokens_per_step`` derives a ``tdx.train.tokens_per_s`` gauge;
+    * ``flops_per_step`` (+ ``peak_tflops``) derive ``tdx.train.tflops``
+      and ``tdx.train.mfu_est`` gauges.
+
+    Works with telemetry disabled too — it then times exactly like the old
+    ``StepTimer`` and records nothing.
+    """
+
+    def __init__(self, *, name: str = "train.step",
+                 tokens_per_step: Optional[int] = None,
+                 flops_per_step: Optional[float] = None,
+                 peak_tflops: Optional[float] = None):
+        self.name = name
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self.peak_tflops = peak_tflops
+        self.steps = 0
+        self.total = 0.0
+        self._t0: Optional[float] = None
+        self._span = None
+
+    def start(self) -> None:
+        from . import enabled, tracer
+
+        if enabled():
+            self._span = tracer().span(self.name, "train", {"step": self.steps})
+            self._span.__enter__()
+        self._t0 = time.perf_counter()
+
+    def stop(self, result: Any = None) -> float:
+        """Close the step; ``result`` (if given) is blocked on first so
+        the duration covers the device work, not just the dispatch."""
+        if result is not None:
+            import jax  # lazy: meter is importable without jax
+
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self._t0
+        self.steps += 1
+        self.total += dt
+        if self._span is not None:
+            span, self._span = self._span, None
+            span.set(**self._derived(dt))
+            span.__exit__(None, None, None)
+            self._set_gauges(dt)
+        return dt
+
+    def _derived(self, dt: float) -> dict:
+        out = {}
+        if self.tokens_per_step:
+            out["tokens_per_s"] = round(self.tokens_per_step / dt, 1)
+        if self.flops_per_step:
+            tflops = self.flops_per_step / dt / 1e12
+            out["tflops"] = round(tflops, 3)
+            if self.peak_tflops:
+                out["mfu_est"] = round(tflops / self.peak_tflops, 4)
+        return out
+
+    def _set_gauges(self, dt: float) -> None:
+        from . import gauge
+
+        gauge("tdx.train.step_ms").set(dt * 1e3)
+        for key, value in self._derived(dt).items():
+            gauge(f"tdx.train.{key}").set(value)
+
+    @property
+    def mean(self) -> float:
+        return self.total / max(1, self.steps)

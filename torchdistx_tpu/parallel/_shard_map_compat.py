@@ -1,0 +1,32 @@
+"""shard_map version compatibility.
+
+The codebase targets the modern API (``jax.shard_map`` with
+``axis_names`` / ``check_vma``).  Older jax ships the function under
+``jax.experimental.shard_map`` with the pre-rename keywords
+(``check_rep``; manual-axes expressed inversely via ``auto``).  The
+adapter is selected by SIGNATURE, not version string or import location,
+so an intermediate release exposing the old signature at the new path
+still adapts correctly.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _impl
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _impl
+
+if "check_vma" in inspect.signature(_impl).parameters:
+    shard_map = _impl
+else:
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        # New-API semantics: axis_names is the set of MANUAL axes (None =
+        # all of them); the legacy keyword is the complement (`auto`).
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _impl(f, mesh, in_specs, out_specs,
+                     check_rep=check_vma, auto=auto)

@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ._shard_map_compat import shard_map
 
 from ..models.configs import TransformerConfig
 from ..models.layers import Block, default_attention

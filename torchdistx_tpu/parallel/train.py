@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observe
 from ..models.configs import TransformerConfig
 from ..models.layers import default_attention
 from .pipeline import (
@@ -202,4 +203,64 @@ def make_train_step(
     def shard_batch(tokens):
         return jax.device_put(tokens, batch_sharding)
 
+    if observe.enabled():
+        # Decided at build time: with telemetry off the raw jitted step is
+        # returned and the loop keeps fully async dispatch.
+        train_step = _instrument_step(train_step, mesh)
+
     return init_state, train_step, shard_batch
+
+
+def _instrument_step(step_fn, mesh: Mesh):
+    """Per-step telemetry around a jitted train step: a ``train.step``
+    span plus ``tdx.train.tokens_per_s`` / ``tdx.train.mfu_est`` gauges,
+    via :class:`torchdistx_tpu.observe.StepMeter` (``StepTimer``'s
+    successor).
+
+    Each step blocks until ready so the span covers device work — that
+    serializes dispatch, which is exactly why this wrapper only exists
+    when telemetry is enabled.  MFU is the 6·N·D parameter-matmul
+    estimate (attention term excluded), labeled ``_est`` accordingly;
+    bench.py's audited FLOP accounting remains the published number.
+
+    The peak is the per-chip figure times the mesh size: flops_per_step
+    is whole-model work executed across every mesh device, so the
+    denominator must be the whole mesh's peak or an N-chip run reports
+    N× the honest MFU."""
+    kind = mesh.devices.flat[0].device_kind
+    chip_peak = observe.peak_tflops_for(kind)
+    peak = chip_peak * mesh.devices.size if chip_peak else None
+    meter = observe.StepMeter(peak_tflops=peak)
+    n_params = None
+
+    def wrapped(state, tokens, segment_ids=None):
+        if not observe.enabled():
+            # Telemetry was turned off after build (e.g. the override
+            # scope that enabled it exited): the meter would record
+            # nothing but still block every step — skip it entirely.
+            return step_fn(state, tokens, segment_ids)
+        if any(
+            isinstance(leaf, jax.core.Tracer)
+            for arg in (tokens, state)
+            for leaf in jax.tree_util.tree_leaves(arg)
+        ):
+            # Being traced inside an outer jit (e.g. bench's fori_loop
+            # chain, where the batch is a closure constant but the state
+            # is the traced carry): host-side timing/blocking is
+            # meaningless at trace time and would publish garbage gauges
+            # — bypass the meter.
+            return step_fn(state, tokens, segment_ids)
+        nonlocal n_params
+        if n_params is None:
+            n_params = sum(
+                int(x.size) for x in jax.tree_util.tree_leaves(state["params"])
+            )
+        ntok = int(tokens.shape[0]) * int(tokens.shape[1])
+        meter.tokens_per_step = ntok
+        meter.flops_per_step = 6.0 * n_params * ntok
+        meter.start()
+        out = step_fn(state, tokens, segment_ids)
+        meter.stop(out)
+        return out
+
+    return wrapped

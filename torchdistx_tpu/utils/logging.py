@@ -1,18 +1,20 @@
-"""Structured logging + lightweight metrics.
+"""Structured logging (plus the deprecated ``Metrics`` shim).
 
-The reference's only observability surface is the fake-tensor repr patch
-(SURVEY.md §5); this module provides the framework-level logger plus a
-minimal metrics sink usable from training loops (counters/gauges with
-JSON-lines export — no external deps)."""
+The framework-level logger lives here; metrics moved to
+:mod:`torchdistx_tpu.observe` (counters/gauges/histograms with
+Chrome-trace, JSON-lines, and Prometheus export).  ``Metrics`` survives
+as a thin deprecation shim over :class:`~torchdistx_tpu.observe.JsonlSink`
+with the original record schema."""
 
 from __future__ import annotations
 
-import json
 import logging
 import sys
-import time
+import warnings
 from pathlib import Path
 from typing import Any, Dict, Optional
+
+from ..observe import JsonlSink
 
 _LOGGER: Optional[logging.Logger] = None
 
@@ -35,25 +37,23 @@ def get_logger() -> logging.Logger:
     return _LOGGER
 
 
-class Metrics:
-    """Append-only metric sink writing JSON lines (one record per log)."""
+class Metrics(JsonlSink):
+    """DEPRECATED shim: use :class:`torchdistx_tpu.observe.JsonlSink` for
+    step records, or the :mod:`torchdistx_tpu.observe` counter registry
+    (``counter``/``gauge``/``histogram`` + ``TDX_METRICS_PATH`` export)
+    for metrics proper.  Same behavior as before: append-only JSON lines,
+    one record per ``log``."""
 
     def __init__(self, path: Optional[str | Path] = None):
+        warnings.warn(
+            "torchdistx_tpu.utils.logging.Metrics is deprecated; use "
+            "torchdistx_tpu.observe.JsonlSink (or observe counters with "
+            "TDX_METRICS_PATH) instead.",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        super().__init__(str(path) if path else None)
         self.path = Path(path) if path else None
-        self._fh = open(self.path, "a") if self.path else None
 
     def log(self, step: int, **values: Any) -> Dict[str, Any]:
-        rec = {"ts": time.time(), "step": step}
-        for k, v in values.items():
-            try:
-                rec[k] = float(v)
-            except (TypeError, ValueError):
-                rec[k] = str(v)
-        if self._fh:
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
-        return rec
-
-    def close(self) -> None:
-        if self._fh:
-            self._fh.close()
+        return super().log(step=step, **values)

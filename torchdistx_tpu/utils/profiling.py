@@ -1,19 +1,26 @@
-"""Profiling / tracing hooks.
+"""Profiling / tracing hooks (XLA-level) + deprecated host-timer shims.
 
-The reference ships no profiling (SURVEY.md §5 — "No timing/profiling
-anywhere"); here the XLA-level story is first-class: ``trace`` wraps
-``jax.profiler`` (view in TensorBoard/XProf), ``annotate`` adds named
-regions to device timelines, and ``Timer`` covers host-side wall timing
-with block-until-ready semantics so compiled-async dispatch does not lie.
+The XLA-level story stays here and is first-class: ``trace`` wraps
+``jax.profiler`` (view in TensorBoard/XProf) and ``annotate`` adds named
+regions to device timelines.  Host-side wall timing moved to
+:mod:`torchdistx_tpu.observe` — ``observe.span`` is the block-until-ready
+aware timer that also lands in the exported trace, and
+``observe.StepMeter`` is the training-loop successor of ``StepTimer``.
+``Timer`` and ``StepTimer`` survive as deprecation shims with their
+original semantics (and, when telemetry is enabled, their measurements
+now flow into the shared tracer too).
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from typing import Any, Dict, Iterator, Optional
+import warnings
+from typing import Any, Iterator, Optional
 
 import jax
+
+from .. import observe
 
 
 @contextlib.contextmanager
@@ -32,7 +39,8 @@ def annotate(name: str):
 
 
 class Timer:
-    """Wall-clock timer that waits for async device work.
+    """DEPRECATED shim: use ``observe.span(name)`` (same block-until-ready
+    semantics, plus the measurement lands in the exported trace).
 
     >>> with Timer() as t:
     ...     out = step(state, batch)
@@ -41,10 +49,19 @@ class Timer:
     """
 
     def __init__(self):
+        warnings.warn(
+            "torchdistx_tpu.utils.profiling.Timer is deprecated; use "
+            "torchdistx_tpu.observe.span(...) instead.",
+            DeprecationWarning,
+            stacklevel=2,
+        )
         self.elapsed: Optional[float] = None
         self._blocked: Any = None
+        self._span = None
 
     def __enter__(self) -> "Timer":
+        self._span = observe.span("utils.Timer", category="compat")
+        self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
@@ -57,27 +74,20 @@ class Timer:
             jax.block_until_ready(self._blocked)
             self._blocked = None  # don't pin device arrays past the scope
         self.elapsed = time.perf_counter() - self._t0
+        span, self._span = self._span, None
+        span.__exit__(None, None, None)
 
 
-class StepTimer:
-    """Running throughput stats for a training loop."""
+class StepTimer(observe.StepMeter):
+    """DEPRECATED shim: use :class:`torchdistx_tpu.observe.StepMeter`
+    (same ``start``/``stop``/``steps``/``total``/``mean`` surface, plus
+    per-step spans and tokens-per-second / MFU gauges)."""
 
     def __init__(self):
-        self.steps = 0
-        self.total = 0.0
-        self._t0: Optional[float] = None
-
-    def start(self) -> None:
-        self._t0 = time.perf_counter()
-
-    def stop(self, result: Any = None) -> float:
-        if result is not None:
-            jax.block_until_ready(result)
-        dt = time.perf_counter() - self._t0
-        self.steps += 1
-        self.total += dt
-        return dt
-
-    @property
-    def mean(self) -> float:
-        return self.total / max(1, self.steps)
+        warnings.warn(
+            "torchdistx_tpu.utils.profiling.StepTimer is deprecated; use "
+            "torchdistx_tpu.observe.StepMeter instead.",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        super().__init__(name="utils.StepTimer")
