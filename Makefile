@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -41,6 +41,14 @@ leak-check:
 
 test:
 	python -m pytest tests/ -q
+
+# The fault-injection suite (docs/robustness.md), INCLUDING the cases
+# tier-1 excludes as `slow` (multi-second hang injection / drain
+# subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
+# CPU reproductions; real-hardware recovery is soaked separately via
+# `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
+chaos-test:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failures.py -q -p no:cacheprovider
 
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,

@@ -19,3 +19,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second cases (long hang injection) excluded from the "
+        "tier-1 run's -m 'not slow'; `make chaos-test` includes them",
+    )

@@ -100,6 +100,107 @@ class TestRunElastic:
             )
 
 
+class TestDeviceHealthDeadline:
+    def test_wedged_device_probe_is_bounded(self, monkeypatch):
+        # A wedged device accepts work and never completes it: make
+        # device_put hang and check the probe reports a timeout instead
+        # of hanging in exactly the state it exists to detect.
+        import threading
+        import time as _time
+
+        import torchdistx_tpu.utils.failures as F
+
+        real_put = jax.device_put
+
+        def wedged_put(x, d):
+            _time.sleep(2.0)
+            return real_put(x, d)
+
+        monkeypatch.setattr(jax, "device_put", wedged_put)
+        try:
+            t0 = _time.perf_counter()
+            report = device_health(devices=[jax.devices()[0]], deadline=0.3)
+            assert _time.perf_counter() - t0 < 5.0
+            assert not report["healthy"]
+            assert "timed out" in report["devices"][0]["error"]
+
+            # Polling again while the probe is still wedged must NOT
+            # stack another doomed thread — unhealthy, immediately.
+            n_before = threading.active_count()
+            report2 = device_health(devices=[jax.devices()[0]], deadline=0.3)
+            assert not report2["healthy"]
+            assert "still wedged" in report2["devices"][0]["error"]
+            assert threading.active_count() <= n_before
+        finally:
+            F._STUCK_PROBES.clear()  # don't poison later device_health users
+
+    def test_deadline_none_keeps_inline_probing(self):
+        report = device_health(deadline=None)
+        assert report["healthy"]
+
+
+class TestBackoff:
+    def test_backoff_schedule_respected(self, tmp_path, monkeypatch):
+        import torchdistx_tpu.utils.failures as F
+
+        sleeps = []
+        monkeypatch.setattr(F.time, "sleep", lambda s: sleeps.append(s))
+        step = TestRunElastic()._step(fail_at={2, 3, 4})
+        out, steps, restarts = run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)] * 4,
+            checkpoint_dir=str(tmp_path), checkpoint_every=1,
+            retry_on=(_Boom,), max_restarts=5, probe_on_restart=False,
+            backoff_base=0.2, backoff_max=0.5,
+        )
+        assert (steps, restarts) == (4, 3)
+        # min(backoff_max, base * 2**(n-1)) for restarts 1..3.
+        assert sleeps == pytest.approx([0.2, 0.4, 0.5])
+
+
+class TestVerifyThenPrune:
+    def test_prune_never_deletes_newest_verified(self, tmp_path, monkeypatch):
+        # A save whose verification fails must be quarantined WITHOUT
+        # pruning the older good checkpoints — prune is strictly
+        # verify-then-prune, and .corrupt dirs don't count toward (or
+        # get deleted by) the keep budget.
+        import torchdistx_tpu.utils.checkpoint as C
+
+        real_verify = C.verify_checkpoint
+
+        def flaky_verify(path):
+            if str(path).rstrip("/").endswith("step_4"):
+                return False, "synthetic verification failure"
+            return real_verify(path)
+
+        monkeypatch.setattr(C, "verify_checkpoint", flaky_verify)
+
+        seen = {}
+
+        def on_metrics(step, _m):
+            if step == 5:
+                seen["step2_survives"] = (tmp_path / "step_2").is_dir()
+                seen["step4_quarantined"] = (tmp_path / "step_4.corrupt").is_dir()
+
+        def step(state, batch):
+            return {"x": state["x"] + batch}, {}
+
+        out, steps, _ = run_elastic(
+            step, {"x": jnp.float32(0.0)}, [jnp.float32(1.0)] * 6,
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            max_to_keep=1, probe_on_restart=False, on_metrics=on_metrics,
+        )
+        assert steps == 6
+        # After the bad step-4 save, step_2 remained the newest verified
+        # checkpoint and was NOT pruned despite max_to_keep=1.
+        assert seen == {"step2_survives": True, "step4_quarantined": True}
+        import os
+
+        names = sorted(os.listdir(tmp_path))
+        assert "step_6" in names            # newest verified
+        assert "step_4.corrupt" in names    # quarantined, never pruned
+        assert "step_2" not in names        # pruned only after 6 verified
+
+
 class TestConfig:
     def test_defaults_from_env(self):
         cfg = tdx_config.get()

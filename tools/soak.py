@@ -11,6 +11,17 @@ regression test.
     python tools/soak.py --seconds 3600 --start 300000
     python tools/soak.py --modes bridge,serialize --seeds 5000
 
+The ``elastic`` mode soaks the chaos-hardened recovery loop instead of a
+replay oracle: each seed runs ``run_elastic`` under a fault plan
+(``--fault-plan``, or a seeded-random one) and asserts the final state
+equals the fault-free run — including across the documented
+relaunch-with-``resume=True`` contract.  On real hardware (a
+``tpu_watch`` window) this exercises recovery against the actual
+accelerator runtime:
+
+    python tools/soak.py --modes elastic --seconds 600 \\
+        --fault-plan 'save@2=corrupt:truncate;step@3=raise'
+
 Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
 exception) and the exit code is non-zero if any occurred.
 """
@@ -28,15 +39,27 @@ import traceback
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
-         "geom", "geom_single", "geom_bridge")
+         "geom", "geom_single", "geom_bridge", "elastic")
+
+_FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
 
-def _init_worker() -> None:
+def _init_worker(fault_plan: "str | None" = None,
+                 platform: str = "cpu") -> None:
+    global _FAULT_PLAN
+    _FAULT_PLAN = fault_plan
     sys.path.insert(0, REPO)
     sys.path.insert(0, os.path.join(REPO, "tests"))
     # One thread per worker: the fuzz tensors are tiny, and N workers ×
     # ncpu intra-op threads would oversubscribe the box.
     os.environ["OMP_NUM_THREADS"] = "1"
+    if platform == "default":
+        # --platform default (elastic-only soaks under a tpu_watch
+        # window): leave the backend alone so recovery is exercised
+        # against the REAL accelerator runtime.  The fuzz oracles never
+        # run in this configuration (main() forces cpu when any is
+        # selected), so torch stays unimported too.
+        return
     import torch
 
     torch.set_num_threads(1)
@@ -46,6 +69,79 @@ def _init_worker() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def _elastic_oracle(seed: int, plan_text: "str | None"):
+    """One chaos-recovery run: inject a fault plan into ``run_elastic``
+    over a deterministic scalar-sum workload and assert the final state
+    equals the fault-free run's — surviving raises, hangs, corruption,
+    slow saves, preemption drains, and the relaunch-with-resume contract
+    when an in-process rewind exceeds the replay window."""
+    import random
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu.utils.failures import ReplayWindowExceeded, run_elastic
+
+    rng = random.Random(seed)
+    n = rng.randrange(6, 13)
+    every = rng.randrange(1, 4)
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        kind = rng.choice(["raise", "hang", "preempt", "corrupt", "slow"])
+        if kind == "corrupt":
+            # Corruption only matters if something restores from it:
+            # damage the newest save before an injected failure.  Never
+            # step 0 — corrupting the only checkpoint is unrecoverable
+            # in-process by design (run_elastic raises; a fresh start is
+            # the only remedy), which is not the contract soaked here.
+            save_step = every * rng.randrange(1, n // every)
+            fail_step = rng.randrange(save_step + 1, n + 1)
+            text = f"save@{save_step}=corrupt:truncate;step@{fail_step}=raise"
+        elif kind == "slow":
+            text = f"save@{every * rng.randrange(0, n // every + 1)}=slow:0.05"
+        else:
+            arg = ":2" if kind == "hang" else ""
+            text = f"step@{rng.randrange(1, n + 1)}={kind}{arg}"
+        plan = chaos.parse_plan(text)
+    expected = float(sum(range(1, n + 1)))
+    batches = [jnp.float32(i) for i in range(1, n + 1)]
+
+    def stepf(state, b):
+        return {"x": state["x"] + b}, {}
+
+    d = tempfile.mkdtemp(prefix="tdx_soak_elastic_")
+    try:
+        chaos.install(plan)
+        steps = 0
+        resume = False
+        out = None
+        for _attempt in range(4):  # preempt drain / relaunch contract
+            try:
+                out, steps, _ = run_elastic(
+                    stepf, {"x": jnp.float32(0.0)}, batches,
+                    checkpoint_dir=d, checkpoint_every=every,
+                    max_restarts=8, step_deadline=0.5, resume=resume,
+                    probe_on_restart=False,
+                )
+            except ReplayWindowExceeded:
+                pass  # documented contract: relaunch with resume=True
+            resume = True
+            if steps >= n:
+                break
+        if steps < n:
+            return ("error", f"did not complete: steps={steps}/{n} plan={plan!r}")
+        if float(out["x"]) != expected:
+            return ("mismatch",
+                    f"final x={float(out['x'])} != {expected} plan={plan!r}")
+    finally:
+        chaos.clear()
+        shutil.rmtree(d, ignore_errors=True)
+    return None
 
 
 def _run_seed(mode: str, seed: int):
@@ -93,6 +189,10 @@ def _run_seed(mode: str, seed: int):
         elif mode == "geom_bridge":
             F._jax_bridge_oracle(seed, allow_data_ops=True,
                                  allow_geom_ops=True)
+        elif mode == "elastic":
+            r = _elastic_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "serialize":
             import tempfile
             from pathlib import Path
@@ -130,6 +230,15 @@ def main() -> int:
                     default=max(2, min(8, (os.cpu_count() or 4) - 2)))
     ap.add_argument("--log", default=os.path.join(REPO, "tools",
                                                   "soak_failures.jsonl"))
+    ap.add_argument("--fault-plan", default=None,
+                    help="chaos plan for --modes elastic (grammar: "
+                         "torchdistx_tpu.chaos / docs/robustness.md); "
+                         "default: a seeded-random plan per seed")
+    ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",
+                    help="jax backend for elastic-only soaks: 'default' "
+                         "soaks recovery on the real accelerator "
+                         "(tpu_watch windows); fuzz modes always force "
+                         "cpu regardless")
     args = ap.parse_args()
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     for m in modes:
@@ -151,7 +260,10 @@ def main() -> int:
     # Cleanup is an unconditional terminate (never join) in the finally
     # below, plus a hard os._exit at the __main__ site so interpreter
     # atexit can't re-join either.
-    pool = ctx.Pool(args.workers, initializer=_init_worker)
+    platform = ("cpu" if any(m != "elastic" for m in modes)
+                else args.platform)
+    pool = ctx.Pool(args.workers, initializer=_init_worker,
+                    initargs=(args.fault_plan, platform))
     try:
         # chunksize must stay 1: with chunksize>1 imap_unordered returns
         # a plain unchunking generator without .next(timeout) (py3.12).

@@ -146,6 +146,25 @@ def summarize(events: List[dict], top: int = 15) -> str:
     if verify:
         lines.append(f"replay verification failures: {int(verify)}")
 
+    # Robustness digest (docs/robustness.md vocabulary).  Labeled counters
+    # arrive as name{label=...} streams — aggregate back by prefix.
+    chaos = sum(
+        v for k, v in counters.items() if k.startswith("tdx.chaos.injected")
+    )
+    rob = [
+        ("restarts", counters.get("tdx.elastic.restarts")),
+        ("watchdog kills", counters.get("tdx.elastic.watchdog_kills")),
+        ("preemption drains", counters.get("tdx.elastic.drains")),
+        ("ckpt verify failures", counters.get("tdx.ckpt.verify_fail")),
+        ("ckpt quarantined", counters.get("tdx.ckpt.quarantined")),
+        ("chaos injected", chaos or None),
+    ]
+    if any(v is not None for _k, v in rob):
+        lines.append(
+            "robustness: "
+            + ", ".join(f"{k}={int(v or 0)}" for k, v in rob if v is not None)
+        )
+
     interesting = {
         k: v for k, v in sorted(counters.items())
         if not k.startswith("tdx.jax.compile_cache")

@@ -12,7 +12,10 @@ mid-list costs at most one item's budget:
 2. ``exactness_onchip.py`` — TPU-codegen bitwise fuzz (budgeted,
    incrementally-flushed artifact);
 3. ``flash_inphase_probe.py fwd`` — the single-inner-k-step headroom
-   candidates from docs/benchmarks.md §Roofline.
+   candidates from docs/benchmarks.md §Roofline;
+4. ``soak.py --modes elastic`` — chaos-recovery soak against the REAL
+   accelerator runtime (injected raise/hang/corrupt faults survived with
+   state equal to the fault-free run; docs/robustness.md).
 
 Each item is re-gated on a fresh compute probe, since the tunnel can
 wedge between items.  Log lines go to stdout.
@@ -41,6 +44,9 @@ WISHLIST = [
     ("capture", ["tools/capture_hw_bench.py"], 9600.0),
     ("exactness", ["tools/exactness_onchip.py", "--seconds", "1200"], 1800.0),
     ("flash_probe", ["tools/flash_inphase_probe.py", "fwd", "420"], 2400.0),
+    ("chaos_soak", ["tools/soak.py", "--modes", "elastic",
+                    "--platform", "default",
+                    "--seconds", "420", "--workers", "2"], 900.0),
 ]
 
 

@@ -1,0 +1,143 @@
+"""Fault executors: turn a matched :class:`~.plan.Fault` into the real
+failure it models.
+
+Every injection increments ``tdx.chaos.injected{kind=...}`` and emits a
+``chaos.injected`` instant event before acting, so a trace of a chaos run
+shows exactly what was injected where — the counter is the ground truth a
+survival test compares recovery behavior against.
+
+The injected *raise* is a real ``XlaRuntimeError`` when jaxlib exposes a
+constructible one (it does on every image we target): recovery code must
+be exercised against the exception type TPU preemptions and chip losses
+actually surface as, not a stand-in.  When construction fails we fall back
+to :class:`InjectedRuntimeError` (a ``RuntimeError``, which the default
+``retry_on`` resolution also covers).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import zlib
+from pathlib import Path
+from typing import Optional
+
+from .. import observe
+from ..utils.logging import get_logger
+from .plan import Fault
+
+_HANG_DEFAULT_S = 3600.0  # "never returns" at test scale; watchdog-killable
+_CORRUPT_MODES = ("truncate", "flip")
+
+
+class InjectedRuntimeError(RuntimeError):
+    """Fallback for ``raise`` faults when XlaRuntimeError cannot be built."""
+
+
+_tls = threading.local()
+
+
+def set_cancel_event(event: "threading.Event | None") -> None:
+    """Install a cancellation event for chaos sleeps on THIS thread.
+
+    ``run_elastic``'s watchdog wrapper sets one per step worker and fires
+    it on abandonment, so an injected ``hang:3600`` wakes and lets the
+    abandoned thread exit instead of sleeping out its full argument —
+    without this a chaos soak leaks one live thread per injected hang."""
+    _tls.cancel = event
+
+
+def _interruptible_sleep(seconds: float) -> None:
+    ev = getattr(_tls, "cancel", None)
+    if ev is None:
+        time.sleep(seconds)
+        return
+    deadline = time.monotonic() + seconds
+    while not ev.is_set():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        ev.wait(min(0.25, remaining))
+
+
+def _xla_runtime_error(msg: str) -> BaseException:
+    try:
+        from jax._src.lib import xla_client
+
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:  # pragma: no cover — depends on jaxlib internals
+        return InjectedRuntimeError(msg)
+
+
+def execute(fault: Fault, *, path: Optional[str] = None) -> None:
+    """Perform ``fault``.  ``path`` is the checkpoint directory for
+    ``save``/``restore`` sites (required by ``corrupt``)."""
+    log = get_logger()
+    observe.counter("tdx.chaos.injected", kind=fault.kind).inc()
+    observe.instant(
+        "chaos.injected", category="chaos",
+        spec=fault.spec(), **({"path": str(path)} if path else {}),
+    )
+    log.warning("chaos: injecting %s%s", fault.spec(),
+                f" (path={path})" if path else "")
+
+    if fault.kind == "raise":
+        raise _xla_runtime_error(f"chaos: injected device failure ({fault.spec()})")
+    if fault.kind == "hang":
+        _interruptible_sleep(float(fault.arg) if fault.arg else _HANG_DEFAULT_S)
+        return
+    if fault.kind == "slow":
+        _interruptible_sleep(float(fault.arg) if fault.arg else 1.0)
+        return
+    if fault.kind == "preempt":
+        # The real preemption notice: SIGTERM to our own process.  The
+        # handler (installed by run_elastic) runs in the MAIN thread no
+        # matter which thread executes this, exactly like a notice from
+        # the resource manager.
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if fault.kind == "corrupt":
+        if path is None:
+            raise ValueError(f"corrupt fault needs a checkpoint path: {fault.spec()}")
+        corrupt_checkpoint(path, mode=fault.arg or "truncate")
+        return
+    raise AssertionError(f"unreachable fault kind {fault.kind!r}")
+
+
+def corrupt_checkpoint(path: "str | Path", mode: str = "truncate") -> str:
+    """Deterministically damage one payload file of a committed checkpoint
+    (post-commit bit-rot / torn-write model).  The victim is the largest
+    payload file — metadata-only damage can slip past a restore that never
+    touches the damaged branch; payload damage cannot.  Returns the
+    relative path of the damaged file.
+    """
+    if mode not in _CORRUPT_MODES:
+        raise ValueError(f"corrupt mode must be one of {_CORRUPT_MODES}, got {mode!r}")
+    path = Path(path)
+    from ..utils.checkpoint import iter_payload_files
+
+    victims = sorted(
+        iter_payload_files(path),
+        key=lambda rel: ((path / rel).stat().st_size, str(rel)),
+    )
+    if not victims:
+        raise FileNotFoundError(f"no payload files to corrupt under {path}")
+    rel = victims[-1]
+    f = path / rel
+    if mode == "truncate":
+        size = f.stat().st_size
+        with open(f, "r+b") as fh:
+            fh.truncate(max(0, size // 2))
+    else:  # flip
+        with open(f, "r+b") as fh:
+            data = bytearray(fh.read())
+            if not data:
+                raise ValueError(f"cannot flip a byte of empty file {f}")
+            # Deterministic victim byte: keyed by content, not RNG.
+            i = zlib.crc32(bytes(data)) % len(data)
+            data[i] ^= 0xFF
+            fh.seek(0)
+            fh.write(data)
+    return str(rel)

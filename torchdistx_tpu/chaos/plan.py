@@ -1,0 +1,136 @@
+"""Fault-plan grammar and bookkeeping.
+
+A fault plan is a deterministic schedule of failures keyed by *site*
+(where in the elastic loop the fault fires) and *step* (the 1-based
+training step it fires at).  Determinism is the point: every failure the
+recovery stack claims to survive can be replayed exactly, in CI, on CPU.
+
+Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
+
+    plan  := entry (';' entry)*
+    entry := site '@' step '=' kind [':' arg] ['x' count]
+    site  := 'step' | 'save' | 'restore'
+    kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
+
+Examples::
+
+    step@4=raise                 # XlaRuntimeError while executing step 4
+    step@3=hang:3600             # step 3 never returns (needs a watchdog)
+    step@5=preempt               # SIGTERM to self at the start of step 5
+    save@4=corrupt:truncate      # damage the step-4 checkpoint POST-commit
+    save@2=slow:0.5              # the step-2 save takes an extra 0.5 s
+    step@4=raise x2              # fires the first TWO times step 4 runs
+
+Each entry fires ``count`` times (default 1) and is then spent — a
+restarted step re-executes fault-free, which is what makes
+recover-and-converge scenarios terminate.  ``corrupt`` args are
+``truncate`` (default) or ``flip``; ``hang``/``slow`` args are seconds.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+SITES = ("step", "save", "restore")
+KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
+
+_ENTRY_RE = re.compile(
+    r"^(?P<site>[a-z_]+)@(?P<step>\d+)=(?P<kind>[a-z_]+)"
+    r"(?::(?P<arg>[^x;]*?))?(?:\s*x(?P<count>\d+))?$"
+)
+
+
+@dataclass
+class Fault:
+    """One scheduled failure.  ``remaining`` counts down as it fires."""
+
+    site: str
+    step: int
+    kind: str
+    arg: Optional[str] = None
+    count: int = 1
+    remaining: int = field(default=-1)  # initialized from count
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r} (one of {SITES})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (one of {KINDS})")
+        if self.count < 1:
+            raise ValueError(f"fault count must be >= 1, got {self.count}")
+        if self.remaining < 0:
+            self.remaining = self.count
+
+    def spec(self) -> str:
+        arg = f":{self.arg}" if self.arg else ""
+        cnt = f" x{self.count}" if self.count != 1 else ""
+        return f"{self.site}@{self.step}={self.kind}{arg}{cnt}"
+
+
+class FaultPlan:
+    """A set of :class:`Fault` entries with thread-safe match-and-consume.
+
+    :meth:`take` returns the faults due at ``(site, step)`` and decrements
+    their budgets atomically, so concurrent callers (the watchdog worker
+    thread vs the main loop) cannot double-fire an entry.
+    """
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults: List[Fault] = list(faults or [])
+        self.fired: List[str] = []  # spec strings, in firing order
+        self._lock = threading.Lock()
+
+    def add(self, fault: Fault) -> "FaultPlan":
+        with self._lock:
+            self.faults.append(fault)
+        return self
+
+    def take(self, site: str, step: int) -> List[Fault]:
+        """Faults due now; their ``remaining`` budgets are consumed."""
+        out: List[Fault] = []
+        with self._lock:
+            for f in self.faults:
+                if f.site == site and f.step == step and f.remaining > 0:
+                    f.remaining -= 1
+                    self.fired.append(f.spec())
+                    out.append(f)
+        return out
+
+    def pending(self) -> List[Fault]:
+        with self._lock:
+            return [f for f in self.faults if f.remaining > 0]
+
+    def __bool__(self) -> bool:  # "is there anything left to inject?"
+        return bool(self.pending())
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({'; '.join(f.spec() for f in self.faults)})"
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse the ``TDX_FAULT_PLAN`` grammar into a :class:`FaultPlan`."""
+    faults: List[Fault] = []
+    for raw in text.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _ENTRY_RE.match(entry)
+        if not m:
+            raise ValueError(
+                f"bad fault-plan entry {entry!r}; expected "
+                f"'site@step=kind[:arg][xN]' (see torchdistx_tpu.chaos)"
+            )
+        arg = (m.group("arg") or "").strip() or None
+        faults.append(
+            Fault(
+                site=m.group("site"),
+                step=int(m.group("step")),
+                kind=m.group("kind"),
+                arg=arg,
+                count=int(m.group("count") or 1),
+            )
+        )
+    return FaultPlan(faults)

@@ -30,6 +30,10 @@ Environment variables (read at first import):
 ``TDX_METRICS_PATH``    File for the telemetry counter registry: Prometheus
                         text format if the path ends in ``.prom``, JSON
                         lines otherwise ("" disables).
+``TDX_FAULT_PLAN``      Deterministic fault-injection plan for the elastic
+                        training stack (:mod:`torchdistx_tpu.chaos`), e.g.
+                        ``"step@4=raise;save@2=corrupt:truncate"``
+                        ("" disables; see docs/robustness.md).
 ======================  ====================================================
 
 Per-scope telemetry works like every other knob::
@@ -57,6 +61,7 @@ class Config:
     log_level: str = "INFO"
     trace_dir: Optional[str] = None
     metrics_path: Optional[str] = None
+    fault_plan: Optional[str] = None
 
 
 def _from_env() -> Config:
@@ -68,6 +73,7 @@ def _from_env() -> Config:
         log_level=os.environ.get("TDX_LOG_LEVEL", "INFO"),
         trace_dir=os.environ.get("TDX_TRACE_DIR", "") or None,
         metrics_path=os.environ.get("TDX_METRICS_PATH", "") or None,
+        fault_plan=os.environ.get("TDX_FAULT_PLAN", "") or None,
     )
 
 

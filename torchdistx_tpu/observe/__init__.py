@@ -40,8 +40,15 @@ Quick tour::
     observe.flush()          # write trace/metrics files now
 
 ``tools/tdx_trace.py`` summarizes a trace directory (top spans by
-self-time, compile-cache hit ratio, platform-fallback count) and merges
-per-process files into one Chrome trace.
+self-time, compile-cache hit ratio, platform-fallback count, robustness
+digest) and merges per-process files into one Chrome trace.
+
+The robustness stack reports through the same vocabulary (see
+docs/robustness.md): ``ckpt.save`` / ``ckpt.restore`` / ``ckpt.verify``
+spans from :mod:`..utils.checkpoint`, ``tdx.elastic.restarts`` /
+``.watchdog_kills`` / ``.drains``, ``tdx.ckpt.verify_fail`` /
+``.quarantined``, and ``tdx.chaos.injected{kind=...}`` counters from
+:mod:`..utils.failures` and :mod:`..chaos`.
 """
 
 from __future__ import annotations

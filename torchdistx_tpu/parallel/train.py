@@ -211,6 +211,51 @@ def make_train_step(
     return init_state, train_step, shard_batch
 
 
+def train_elastic(
+    model,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    params,
+    batches,
+    *,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    step_options: Optional[Dict[str, Any]] = None,
+    **elastic_kw,
+):
+    """:func:`make_train_step` wired into the chaos-hardened elastic loop.
+
+    Builds the jitted train step, initializes optimizer state from
+    ``params``, and runs ``utils.failures.run_elastic`` over ``batches``
+    (each batch is a ``[B, S]`` token array, sharded onto the mesh's data
+    axes before the step).  All of ``run_elastic``'s hardening rides
+    along — periodic integrity-manifested checkpoints, restore-on-failure
+    with quarantine fallback, the step watchdog, SIGTERM drain, and
+    :mod:`torchdistx_tpu.chaos` fault plans — as does the telemetry both
+    layers emit (``train.step`` spans next to ``ckpt.*`` spans and
+    ``tdx.elastic.*`` counters in one trace).
+
+    ``step_options`` forwards to :func:`make_train_step` (pipeline
+    schedule, batch axes, ...); ``elastic_kw`` forwards to
+    ``run_elastic`` (``checkpoint_dir``, ``checkpoint_every``,
+    ``step_deadline``, ``resume``, ...).  Packed ``segment_ids`` are not
+    threaded through this convenience loop — call ``make_train_step``
+    directly for packed batches.
+
+    Returns ``(state, steps_completed, restarts_used)``.
+    """
+    from ..utils.failures import run_elastic
+
+    init_state, train_step, shard_batch = make_train_step(
+        model, cfg, mesh, optimizer=optimizer, **(step_options or {})
+    )
+    state = init_state(params)
+
+    def step(state_now, tokens):
+        return train_step(state_now, shard_batch(tokens))
+
+    return run_elastic(step, state, batches, **elastic_kw)
+
+
 def _instrument_step(step_fn, mesh: Mesh):
     """Per-step telemetry around a jitted train step: a ``train.step``
     span plus ``tdx.train.tokens_per_s`` / ``tdx.train.mfu_est`` gauges,
