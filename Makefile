@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -49,6 +49,28 @@ test:
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
 chaos-test:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failures.py -q -p no:cacheprovider
+
+# Fast CPU slice of bench.py under tier-1 constraints, so materialize-
+# path regressions fail in CI instead of only in nightly bench: the
+# engine A/B phase (small depth — the gate is bitwise parity and a sane
+# engine split, not the full-scale speedup) plus the static schedule
+# analysis.  Each phase prints one JSON line; the python step asserts
+# the parity bit and the absence of an error key.
+bench-smoke:
+	JAX_PLATFORMS=cpu TDX_BENCH_PLATFORM=cpu TDX_PIPE_BENCH_LAYERS=32 \
+	    TDX_PIPE_BENCH_REPEATS=1 timeout -k 10 540 \
+	    python bench.py --phase materialize_pipeline | tail -1 \
+	    | python -c "import json,sys; r=json.load(sys.stdin); \
+	        assert r.get('bitwise_equal') is True, r; \
+	        wc = r.get('warm_cache') or {}; \
+	        assert wc.get('hit') and 'miss' not in wc, r; \
+	        print('materialize_pipeline OK:', \
+	              'speedup', r.get('pipeline_speedup'), \
+	              'programs', r.get('n_programs'))"
+	JAX_PLATFORMS=cpu TDX_BENCH_PLATFORM=cpu timeout -k 10 120 \
+	    python bench.py --phase pp_bubble | tail -1 \
+	    | python -c "import json,sys; r=json.load(sys.stdin); \
+	        assert 'schedule_analysis' in r, r; print('pp_bubble OK')"
 
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,

@@ -58,6 +58,11 @@ _HOST_PHASES = {
                            "export_tpu_s": 1.13, "export_mb": 0.06,
                            "n_params": 46702792736, "n_outputs": 14,
                            "rss_mb": 428.6},
+    "materialize_pipeline": {
+        "n_layers": 128, "n_cpus": 8, "repeats": 3, "cold_off_s": 36.6,
+        "cold_auto_s": 26.0, "warm_auto_s": 4.0, "n_programs": 21,
+        "workers": 4, "overlap": 3.8, "bitwise_equal": True,
+        "pipeline_speedup": 1.408, "backend": "cpu", "_backend": "cpu"},
     "pp_bubble": {"schedule_analysis": {"pp4_v2_m8": {"interleaved_ticks": 26}}},
     "schedule_measured": {"schedule_measured": {
         "gpipe_step_ms": 1769.0, "flat_1f1b_step_ms": 2509.0,
@@ -122,8 +127,44 @@ def test_healthy_branch_headline_and_detail(bench):
     assert headline["mixtral_8x7b_rss_mb"] == 428.6
     assert full["llama_1p9b_vs_baseline"] == round(266.0 / 2.6, 3)
     assert full["llama_big_param_dtype"] == "bfloat16"
+    assert headline["pipeline_speedup"] == 1.408
+    assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208
     assert json.load(open(Path(bench.REPO) / "bench_full.json")) == full
+
+
+def test_fallback_expired_cache_not_promoted(bench, monkeypatch):
+    # A cached hardware headline older than TDX_BENCH_MAX_STALE_S must be
+    # marked expired and kept OUT of value/vs_baseline (round 5 published
+    # a 118k-second-old number with no bound).
+    monkeypatch.delenv("TDX_BENCH_MAX_STALE_S", raising=False)
+    _write_hw(bench, "gpt2_ours", {"t": 2.7, "rss_mb": 1800.0},
+              age_s=118_000)
+    _write_hw(bench, "gpt2_baseline", {"t": 33.1, "rss_mb": 2500.0},
+              age_s=118_000)
+    payloads = {
+        **_HOST_PHASES,
+        "gpt2_baseline": {"t": 400.0, "rss_mb": 2500.0, "_backend": "cpu"},
+        "gpt2_ours": {"t": 60.0, "rss_mb": 1800.0, "warm": False,
+                      "materialize_gbps": 0.008, "_backend": "cpu"},
+    }
+    bench._preflight_platform = (
+        lambda: "cpu(fallback: accelerator backend unreachable)")
+    full, headline, lines = _run_main(bench, payloads)
+    assert headline["headline_from_cache"] is False
+    assert 117_000 <= full["headline_cache_expired_s"] <= 119_000
+    assert full["headline_cache_max_stale_s"] == 86400
+    # The headline pair stays the fresh (CPU-labeled) measurement.
+    assert headline["value"] == 60.0
+    assert headline["vs_baseline"] == round(400.0 / 60.0, 3)
+    assert "headline_age_s" not in full
+
+    # Raising the bound re-admits the same cache entries.
+    monkeypatch.setenv("TDX_BENCH_MAX_STALE_S", "200000")
+    full2, headline2, _ = _run_main(bench, payloads)
+    assert headline2["headline_from_cache"] is True
+    assert headline2["vs_baseline"] == round(33.1 / 2.7, 3)
+    assert "headline_cache_expired_s" not in full2
 
 
 def test_fallback_branch_promotes_cached_hardware(bench):

@@ -1,0 +1,231 @@
+"""Warm the persistent XLA compilation cache with init programs.
+
+The cold half of the north-star workflow: a login host deferred-inits a
+model (fakes, zero storage), lowers its init programs, and compiles them
+into the persistent cache directory (``--cache-dir`` / TDX_CACHE_DIR).  A
+later ``materialize_module_jax`` on any host sharing that cache — the pod
+restart path, a CI cold start — then hits every entry instead of paying
+XLA compilation, the dominant cost of the cold path.
+
+BOTH program sets are warmed so either engine mode starts hot:
+
+* the whole-model monolithic program (``TDX_MATERIALIZE_PIPELINE=off``,
+  also the export path's program);
+* the per-group programs the pipelined engine
+  (``TDX_MATERIALIZE_PIPELINE=auto``, default) will request — the split
+  is deterministic for a given recording and config, so the compiled set
+  matches exactly.  Warm with the same ``TDX_COMPILE_WORKERS`` (and mesh
+  / plan / param_dtype) the consumer will run with.
+
+Usage::
+
+    python tools/warm_cache.py --model gpt2 --cache-dir .jax_cache
+    python tools/warm_cache.py --model llama-1b9 --cache-dir /nfs/cache \\
+        --host-devices 8 --mesh fsdp=4,tp=2 --param-dtype bfloat16
+    python tools/warm_cache.py --module mypkg.models:build --cache-dir d
+
+Cache-key caveats: entries are keyed on backend, topology, and compile
+options — warm on the platform (and device count) the consumer will see.
+XLA:CPU entries are additionally host-ISA-specific AOT code (bench.py
+partitions its CPU cache by ISA tag for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default=None,
+                   help="named model: gpt2 | llama-1b9 | t5-small | demo")
+    p.add_argument("--module", default=None,
+                   help="custom factory 'pkg.mod:fn' returning an "
+                        "(eagerly constructible) torch.nn.Module; recorded "
+                        "under deferred_init")
+    p.add_argument("--cache-dir", required=True,
+                   help="persistent compilation cache directory to fill")
+    p.add_argument("--mesh", default=None,
+                   help="mesh axes, e.g. fsdp=4,tp=2 (omit for single-device)")
+    p.add_argument("--plan", default="fsdp", choices=("fsdp", "gspmd2d"),
+                   help="sharding plan used with --mesh (default fsdp)")
+    p.add_argument("--param-dtype", default=None,
+                   help="cast policy, e.g. bfloat16 (matches the "
+                        "materialize-time param_dtype)")
+    p.add_argument("--host-devices", type=int, default=0,
+                   help="force an N-device virtual CPU topology (login "
+                        "hosts warming for a pod slice shape)")
+    p.add_argument("--skip-groups", action="store_true",
+                   help="warm only the whole-model program")
+    p.add_argument("--skip-whole", action="store_true",
+                   help="warm only the per-group programs")
+    return p.parse_args(argv)
+
+
+def _model_factory(args):
+    if (args.model is None) == (args.module is None):
+        raise SystemExit("exactly one of --model / --module is required")
+    if args.module:
+        modname, _, fn = args.module.partition(":")
+        if not fn:
+            raise SystemExit("--module must be 'pkg.mod:factory'")
+        factory = getattr(importlib.import_module(modname), fn)
+        return lambda: factory()
+    name = args.model
+    if name == "demo":
+        return _demo_model
+    if name == "gpt2":
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        return lambda: GPT2LMHeadModel(GPT2Config())
+    if name == "llama-1b9":
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        return lambda: LlamaForCausalLM(LlamaConfig(
+            vocab_size=64128, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=4096,
+        ))
+    if name == "t5-small":
+        from transformers import T5Config, T5ForConditionalGeneration
+
+        return lambda: T5ForConditionalGeneration(T5Config(
+            d_model=512, d_ff=2048, num_layers=6, num_heads=8,
+            vocab_size=32128, d_kv=64,
+        ))
+    raise SystemExit(f"unknown --model {name!r}")
+
+
+def _demo_model():
+    """Tiny heterogeneous stack (distinct widths → several structural
+    groups) — exercises the full warm→hit round trip in seconds; used by
+    the test suite."""
+    import torch
+
+    widths = [32 + 8 * i for i in range(12)]
+
+    class Demo(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = torch.nn.ModuleList(
+                torch.nn.Linear(widths[i], widths[(i + 1) % len(widths)])
+                for i in range(len(widths))
+            )
+
+    return Demo()
+
+
+def _parse_mesh(spec):
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
+         skip_whole=False, skip_groups=False) -> dict:
+    """Compile a module factory's init programs into ``cache_dir``;
+    returns a summary dict.  Importable (the tests drive it in-process);
+    ``main`` is the CLI shell around it."""
+    import jax
+
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu.deferred_init import deferred_init
+    from torchdistx_tpu.jax_bridge import materialize as mat
+
+    # The tool exists to persist: never let jax's 0.1 s min-compile-time
+    # threshold silently skip writing the fast-compiling group programs
+    # this run claims to have warmed (explicit env wins; the prior value
+    # is restored on exit — warm() is documented as importable, and an
+    # in-process caller must keep the documented persist boundary).
+    prior_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+    t0 = time.perf_counter()
+    module = deferred_init(factory)
+    summary = {"programs": 0, "outputs": 0}
+    try:
+        with tdx_config.override(cache_dir=cache_dir):
+            mat._reset_cache_binding()  # bind THIS dir even mid-process
+            mat._maybe_enable_cache()
+            opts = mat._compiler_options()
+
+            def compile_one(lowered, names):
+                (
+                    lowered.compile(compiler_options=opts)
+                    if opts is not None else lowered.compile()
+                )
+                summary["programs"] += 1
+                summary["outputs"] += len(names)
+
+            if not skip_whole:
+                lowered, names = mat.lower_init_module(
+                    module, mesh=mesh, plan=plan, param_dtype=param_dtype
+                )
+                compile_one(lowered, names)
+            if not skip_groups:
+                for lowered, names in mat.lower_init_groups(
+                    module, mesh=mesh, plan=plan, param_dtype=param_dtype
+                ):
+                    compile_one(lowered, names)
+    finally:
+        mat._reset_cache_binding()
+        if prior_min is None:
+            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["TDX_CACHE_MIN_COMPILE_S"] = prior_min
+    try:
+        summary["cache_entries"] = len(os.listdir(cache_dir))
+    except OSError:
+        summary["cache_entries"] = 0
+    summary["seconds"] = round(time.perf_counter() - t0, 2)
+    summary["backend"] = jax.default_backend()
+    summary["cache_dir"] = cache_dir
+    return summary
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.host_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.host_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    mesh = plan = None
+    if args.mesh:
+        from torchdistx_tpu.parallel import (
+            fsdp_plan, gspmd_2d_plan, make_mesh,
+        )
+
+        mesh = make_mesh(_parse_mesh(args.mesh))
+        plan = fsdp_plan() if args.plan == "fsdp" else gspmd_2d_plan()
+    param_dtype = None
+    if args.param_dtype:
+        import jax.numpy as jnp
+
+        param_dtype = getattr(jnp, args.param_dtype)
+
+    os.makedirs(args.cache_dir, exist_ok=True)
+    summary = warm(
+        _model_factory(args), args.cache_dir, mesh=mesh, plan=plan,
+        param_dtype=param_dtype, skip_whole=args.skip_whole,
+        skip_groups=args.skip_groups,
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,18 @@ Environment variables (read at first import):
 ``TDX_RNG_CHUNK``       Row-chunk element count for large RNG draws in the
                         jax bridge (compile-time control; see
                         jax_bridge/ops.py).
+``TDX_MATERIALIZE_PIPELINE``
+                        Materialization engine mode: ``auto`` (default)
+                        splits the recorded init graph along structural
+                        groups and pipelines per-group compile/execute when
+                        the model is large enough; ``off`` forces the
+                        monolithic single-program path (see
+                        docs/performance.md).
+``TDX_COMPILE_WORKERS`` Thread-pool size for the pipelined materializer's
+                        concurrent lower+compile stage (0 = auto-size from
+                        the host's CPU count; XLA compilation releases the
+                        GIL, so workers overlap for real on multi-core
+                        hosts).
 ``TDX_LOG_LEVEL``       Logging level name for the framework logger.
 ``TDX_TRACE_DIR``       Directory for runtime telemetry traces: when set,
                         :mod:`torchdistx_tpu.observe` collects spans across
@@ -50,7 +62,7 @@ import threading
 from dataclasses import dataclass, replace
 from typing import Iterator, Optional
 
-__all__ = ["Config", "get", "override", "set_flags"]
+__all__ = ["Config", "bind", "get", "override", "set_flags"]
 
 
 @dataclass(frozen=True)
@@ -62,6 +74,8 @@ class Config:
     trace_dir: Optional[str] = None
     metrics_path: Optional[str] = None
     fault_plan: Optional[str] = None
+    materialize_pipeline: str = "auto"
+    compile_workers: int = 0
 
 
 def _from_env() -> Config:
@@ -74,6 +88,8 @@ def _from_env() -> Config:
         trace_dir=os.environ.get("TDX_TRACE_DIR", "") or None,
         metrics_path=os.environ.get("TDX_METRICS_PATH", "") or None,
         fault_plan=os.environ.get("TDX_FAULT_PLAN", "") or None,
+        materialize_pipeline=os.environ.get("TDX_MATERIALIZE_PIPELINE", "auto"),
+        compile_workers=int(os.environ.get("TDX_COMPILE_WORKERS", "0")),
     )
 
 
@@ -97,14 +113,27 @@ def set_flags(**kw) -> Config:
         return _base
 
 
+def override(**kw):
+    """Thread-local scoped override: ``with override(native=False): ...``
+    (a :func:`bind` of the current effective config with ``kw`` replaced)."""
+    return bind(replace(get(), **kw))
+
+
 @contextlib.contextmanager
-def override(**kw) -> Iterator[Config]:
-    """Thread-local scoped override: ``with override(native=False): ...``"""
+def bind(cfg: Config) -> Iterator[Config]:
+    """Thread-local scope binding an EXACT ``Config``.
+
+    :func:`override` scopes live on the calling thread's stack and are
+    invisible to worker threads; subsystems that fan work out (the
+    pipelined materializer's compile pool) capture ``get()`` on the
+    submitting thread and re-enter it on each worker with this, so
+    per-scope knobs — telemetry activation, ``rng_chunk_elems``, cache
+    dir — mean the same thing on every thread of one logical operation."""
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
-    stack.append(replace(get(), **kw))
+    stack.append(cfg)
     try:
-        yield stack[-1]
+        yield cfg
     finally:
         stack.pop()

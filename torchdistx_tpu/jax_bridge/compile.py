@@ -603,6 +603,89 @@ def _group_uses_rng(rep: List[OpNode], need: List[Tuple[int, int]]) -> bool:
     return probe.used_rng
 
 
+# ---------------------------------------------------------------------------
+# Per-group program splitting (the pipelined materialization engine's unit)
+#
+# The monolithic path traces EVERY component into one XLA program, so a model
+# whose layers defeat instance batching (distinct shapes per layer — pyramid
+# widths, heterogeneous stacks) compiles one giant module, and XLA compile
+# time is superlinear in module size.  Splitting along the same structural
+# fingerprint groups the batching machinery already computes yields
+# independently jittable sub-programs that (a) compile in sum cheaper than
+# the monolith at scale and (b) can be lowered/compiled concurrently and
+# executed as each executable lands (materialize._run_init_pipelined).
+# Correctness needs no inter-program protocol: components are
+# dependency-closed (storage-aliased constants are unioned into one
+# component by _components), and per-op fold_in RNG keys make every value
+# independent of which program computes it — bitwise-identical either way.
+# ---------------------------------------------------------------------------
+
+
+def split_init_groups(
+    fakes: Sequence[FakeTensor], max_programs: int = 8,
+    *, nodes: Optional[List[OpNode]] = None
+) -> List[List[int]]:
+    """Partition the indices of ``fakes`` into at most ``max_programs``
+    bins of structurally related components, each bin an independently
+    jittable sub-program (feed ``[fakes[i] for i in bin]`` to
+    :func:`build_init_fn`).
+
+    Components are grouped by structural fingerprint first (so instance
+    batching inside each sub-program stays as effective as in the
+    monolith), then fingerprint groups are greedily cost-balanced into
+    bins — compile cost scales with unique structure size, so the cost
+    proxy is the representative's node count plus a small per-instance
+    term.  Deterministic for a given recording and ``max_programs``:
+    ``tools/warm_cache.py`` relies on replaying the exact program set a
+    later materialize will request, possibly on a different host.
+
+    ``nodes`` may pass a precollected ``collect_nodes(fakes)`` result so
+    callers that already walked the graph don't walk it twice.
+    """
+    if nodes is None:
+        nodes = collect_nodes(fakes)
+    comps = _components(nodes)
+    node2comp: Dict[int, int] = {}
+    for ci, comp in enumerate(comps):
+        for n in comp:
+            node2comp[id(n)] = ci
+
+    sig2group: Dict[Any, int] = {}
+    comp2group: Dict[int, int] = {}
+    group_cost: List[int] = []
+    for ci, comp in enumerate(comps):
+        local_index = {id(n): j for j, n in enumerate(comp)}
+        sig = tuple(_node_sig(n, local_index) for n in comp)
+        gi = sig2group.get(sig)
+        if gi is None:
+            gi = sig2group[sig] = len(group_cost)
+            group_cost.append(16 * len(comp))  # unique structure: compile cost
+        else:
+            group_cost[gi] += 1  # repeat instance: scan-iteration cost only
+        comp2group[ci] = gi
+
+    group_slots: Dict[int, List[int]] = {}
+    for i, f in enumerate(fakes):
+        ctx = get_fake_context(f, CONTEXT_KEY)
+        gi = comp2group[node2comp[id(ctx.node)]]
+        group_slots.setdefault(gi, []).append(i)
+
+    # Greedy cost-balanced bin-pack of the slot-owning groups (groups no
+    # requested output reads contribute nothing and are dropped, exactly
+    # as build_init_fn skips them).  Largest first, stable tiebreak.
+    order = sorted(group_slots, key=lambda g: (-group_cost[g], g))
+    n_bins = max(1, min(len(order), max_programs))
+    bins: List[List[int]] = [[] for _ in range(n_bins)]
+    bin_cost = [0] * n_bins
+    for g in order:
+        j = bin_cost.index(min(bin_cost))
+        bins[j].extend(group_slots[g])
+        bin_cost[j] += group_cost[g]
+    out = [sorted(b) for b in bins if b]
+    out.sort(key=lambda b: b[0])  # deterministic program order
+    return out
+
+
 def build_init_fn(
     fakes: Sequence[FakeTensor], *, dedup: bool = True
 ) -> Callable[..., Tuple[jax.Array, ...]]:

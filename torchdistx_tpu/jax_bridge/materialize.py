@@ -7,13 +7,29 @@ TPU mesh.  Where the reference replays eagerly onto the recorded device
 ``jax.jit(..., out_shardings=plan)`` so XLA partitions the entire init
 computation — each device computes and stores only its own shard, and peak
 host RSS stays O(largest metadata), not O(model size).
+
+Two engines share one contract (bitwise-identical outputs, chosen by
+``TDX_MATERIALIZE_PIPELINE`` — see docs/performance.md):
+
+* **monolithic** (``off``): the whole recording traced into ONE jitted
+  program — lower → compile → execute, serially;
+* **pipelined** (``auto``, default): the recording split along structural
+  groups (:func:`..compile.split_init_groups`) into independently jittable
+  sub-programs; a thread pool lowers and compiles them concurrently (XLA
+  compilation releases the GIL), and a dispatcher executes each group as
+  its executable lands, streaming outputs into their planned
+  ``NamedSharding``s.  Host-side Python trace, XLA compile, and device
+  execution overlap instead of serializing — and at scale the split itself
+  beats the monolith's superlinear compile even single-threaded.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
-from typing import Dict, Iterator, Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import torch
@@ -23,7 +39,7 @@ from .. import observe
 from .._graph import gc_paused
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
-from .compile import build_init_fn
+from .compile import build_init_fn, split_init_groups
 
 __all__ = [
     "materialize_tensor_jax",
@@ -31,6 +47,8 @@ __all__ = [
     "materialize_params_jax",
     "materialize_module_jax",
     "lower_init_module",
+    "lower_init_groups",
+    "last_run_stats",
 ]
 
 # Init programs execute once for milliseconds; optimized codegen buys
@@ -47,82 +65,162 @@ _INIT_COMPILER_OPTIONS = {
     "xla_allow_excess_precision": False,
 }
 _options_supported: Optional[dict] = None
+_options_lock = threading.Lock()
 
 
 def _compiler_options() -> Optional[dict]:
     """The subset of _INIT_COMPILER_OPTIONS the active backend accepts,
     probed per option (a backend rejecting the perf knob must not also
-    silently drop the parity-critical precision knob)."""
+    silently drop the parity-critical precision knob).  ONE probe program
+    is lowered and recompiled per option key; the whole probe runs under
+    a lock because pipelined materialization calls this from several
+    compile workers at once."""
     global _options_supported
-    if _options_supported is None:
-        accepted = {}
-        for key, value in _INIT_COMPILER_OPTIONS.items():
-            try:
-                jax.jit(lambda: jax.numpy.zeros(())).lower().compile(
-                    compiler_options={key: value}
-                )
-                accepted[key] = value
-                outcome = "accepted"
-            except Exception:
-                outcome = "rejected"
-                if key == "xla_allow_excess_precision":
-                    import warnings
+    with _options_lock:
+        if _options_supported is None:
+            accepted = {}
+            probe = jax.jit(lambda: jax.numpy.zeros(())).lower()
+            for key, value in _INIT_COMPILER_OPTIONS.items():
+                try:
+                    probe.compile(compiler_options={key: value})
+                    accepted[key] = value
+                    outcome = "accepted"
+                except Exception:
+                    outcome = "rejected"
+                    if key == "xla_allow_excess_precision":
+                        import warnings
 
-                    warnings.warn(
-                        "backend rejects xla_allow_excess_precision=False; "
-                        "recorded bf16 chains may read excess-precision f32 "
-                        "intermediates, losing bitwise parity with torch "
-                        "replay."
+                        warnings.warn(
+                            "backend rejects xla_allow_excess_precision=False; "
+                            "recorded bf16 chains may read excess-precision f32 "
+                            "intermediates, losing bitwise parity with torch "
+                            "replay."
+                        )
+                if observe.enabled():
+                    # Probed once per process; the outcome is provenance a
+                    # trace reader needs (a backend silently dropping the
+                    # parity knob changes what the numbers mean).
+                    observe.counter(
+                        f"tdx.jax.compiler_option_{outcome}", option=key
+                    ).inc()
+                    observe.instant(
+                        "jax.compiler_option_probe", category="jax",
+                        option=key, outcome=outcome,
                     )
-            if observe.enabled():
-                # Probed once per process; the outcome is provenance a
-                # trace reader needs (a backend silently dropping the
-                # parity knob changes what the numbers mean).
-                observe.counter(
-                    f"tdx.jax.compiler_option_{outcome}", option=key
-                ).inc()
-                observe.instant(
-                    "jax.compiler_option_probe", category="jax",
-                    option=key, outcome=outcome,
-                )
-        _options_supported = accepted
-    return _options_supported or None
+            _options_supported = accepted
+        return _options_supported or None
 
 
 _cache_enabled = False
+_cache_latch_lock = threading.Lock()
 
 
 def _maybe_enable_cache() -> None:
     """Point jax's persistent compilation cache at config.cache_dir
     (TDX_CACHE_DIR) so repeated materializations of the same model skip
-    XLA compilation — the dominant cost of the cold path."""
+    XLA compilation — the dominant cost of the cold path.  Guarded: the
+    pipelined engine's workers must not race the once-per-process latch."""
     global _cache_enabled
-    if _cache_enabled:
-        return
-    from .. import config
+    with _cache_latch_lock:
+        if _cache_enabled:
+            return
+        from .. import config
 
-    cache_dir = config.get().cache_dir
-    if cache_dir:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # TDX_CACHE_MIN_COMPILE_S=0 persists even trivial programs —
-        # tests use it to exercise the compile-cache hit/miss telemetry
-        # deterministically with toy models.
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs",
-            float(os.environ.get("TDX_CACHE_MIN_COMPILE_S", "0.1")),
-        )
-        # jax memoizes a once-per-process "cache used?" decision at the
-        # FIRST compile; any compile before this point (even the
-        # PRNGKey seed computation) latches it to "unused" and every
-        # later materialize silently skips the cache.  reset_cache()
-        # un-latches so the dir set above actually binds.
+        cache_dir = config.get().cache_dir
+        if cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # TDX_CACHE_MIN_COMPILE_S=0 persists even trivial programs —
+            # tests use it to exercise the compile-cache hit/miss telemetry
+            # deterministically with toy models.
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(os.environ.get("TDX_CACHE_MIN_COMPILE_S", "0.1")),
+            )
+            # jax memoizes a once-per-process "cache used?" decision at the
+            # FIRST compile; any compile before this point (even the
+            # PRNGKey seed computation) latches it to "unused" and every
+            # later materialize silently skips the cache.  reset_cache()
+            # un-latches so the dir set above actually binds.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:
+                pass
+            _cache_enabled = True
+
+
+def _reset_cache_binding() -> None:
+    """Un-latch the cache binding so the NEXT materialize re-reads
+    config.cache_dir (tests, tools/warm_cache.py, and bench variants
+    that switch cache dirs mid-process; normal runs never need this).
+    Also unbinds the jax-level directory: a later materialize with no
+    cache configured must report ``uncached`` and stop persisting into
+    the previously bound dir, not keep using it by inertia."""
+    global _cache_enabled
+    with _cache_latch_lock:
+        _cache_enabled = False
         try:
+            jax.config.update("jax_compilation_cache_dir", None)
             from jax._src import compilation_cache as _cc
 
             _cc.reset_cache()
         except Exception:
             pass
-        _cache_enabled = True
+
+
+# -- compile-cache outcome accounting ---------------------------------------
+#
+# The hit/miss oracle is jax's own monitoring stream: a persistent-cache
+# HIT records '/jax/compilation_cache/cache_hits' and a persisted MISS
+# records '/jax/compilation_cache/cache_misses', both synchronously on the
+# thread running the compile — so attributing events through a
+# thread-local keeps the counters EXACT even with TDX_COMPILE_WORKERS
+# compiles in flight at once (the old before/after directory differencing
+# could misattribute entries written by a concurrent compile).  A miss too
+# fast/small to persist records nothing and still counts as "miss", the
+# same boundary bench.py's warm stamp documents.
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_mon_tls = threading.local()
+_listener_state: Optional[bool] = None  # None = not yet attempted
+_listener_lock = threading.Lock()
+
+
+def _on_jax_event(event: str, **kw) -> None:
+    rec = getattr(_mon_tls, "events", None)
+    if rec is not None and event in (_HIT_EVENT, _MISS_EVENT):
+        rec.append(event)
+
+
+def _install_cache_listener() -> bool:
+    """Register the jax monitoring listener once; False when this jax has
+    no monitoring API (the caller falls back to directory differencing)."""
+    global _listener_state
+    with _listener_lock:
+        if _listener_state is None:
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_listener(_on_jax_event)
+                _listener_state = True
+            except Exception:
+                _listener_state = False
+        return _listener_state
+
+
+def _persistent_cache_entries() -> Optional[set]:
+    """Filenames in jax's persistent compilation cache dir, or None when
+    no cache is configured.  Only the monitoring-less fallback path still
+    differences this before/after a compile."""
+    d = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not d:
+        return None
+    try:
+        return set(os.listdir(d))
+    except OSError:
+        return set()
 
 
 def _cast_outputs(init_fn, param_dtype, mask=None):
@@ -152,59 +250,280 @@ def _cast_outputs(init_fn, param_dtype, mask=None):
     return fn
 
 
-def _persistent_cache_entries() -> Optional[set]:
-    """Filenames in jax's persistent compilation cache dir, or None when
-    no cache is configured.  Differencing before/after a compile is the
-    hit/miss oracle (same technique bench.py's warm stamp uses): a MISS
-    writes its entry, a HIT writes nothing."""
-    d = getattr(jax.config, "jax_compilation_cache_dir", None)
-    if not d:
-        return None
-    try:
-        return set(os.listdir(d))
-    except OSError:
-        return set()
+# -- run-stats (bench.py reads these to split gbps into its real phases) ----
+
+_stats_lock = threading.Lock()
+_last_run_stats: Dict = {}
 
 
-def _run_init(init_fn, key, out_shardings=None):
-    _maybe_enable_cache()
+def last_run_stats() -> Dict:
+    """Phase breakdown of the most recent materialization in this process:
+    ``mode`` (monolithic|pipelined), ``n_programs``, ``workers``,
+    ``lower_s`` / ``compile_s`` (summed thread-wall time across
+    programs), ``execute_s`` (monolithic: device execution; pipelined:
+    dispatch plus the residual device wait not hidden behind compiles),
+    ``wall_s``, ``overlap`` (busy/wall; >1 means phases genuinely
+    overlapped), and ``cache`` (outcome → count)."""
+    with _stats_lock:
+        return dict(_last_run_stats)
+
+
+def _set_run_stats(**kw) -> None:
+    with _stats_lock:
+        _last_run_stats.clear()
+        _last_run_stats.update(kw)
+
+
+def _compile_program(init_fn, key, out_shardings, label=None):
+    """jit → lower → compile ONE init program; returns
+    ``(compiled, lower_s, compile_s, cache_outcome)``.  Safe to call from
+    several threads at once — jax tracing is thread-local and the cache
+    outcome is attributed through this thread's monitoring record."""
     if out_shardings is not None:
         jitted = jax.jit(init_fn, out_shardings=out_shardings)
     else:
         jitted = jax.jit(init_fn)
     opts = _compiler_options()
-    if not observe.enabled():
-        if opts is None:
-            return jitted(key)
-        return jitted.lower(key).compile(compiler_options=opts)(key)
-    # Instrumented path: the same lower→compile→execute pipeline, staged
-    # explicitly so each phase gets its own span and the compile-cache
-    # outcome is counted per program.
-    with observe.span("jax.lower", category="jax"):
+    attrs = {} if label is None else {"group": label}
+    t0 = time.perf_counter()
+    with observe.span("jax.lower", category="jax", **attrs):
         lowered = jitted.lower(key)
-    before = _persistent_cache_entries()
-    with observe.span("jax.compile", category="jax") as csp:
-        compiled = (
-            lowered.compile(compiler_options=opts)
-            if opts is not None else lowered.compile()
-        )
-        after = _persistent_cache_entries()
-        if before is None:
+    t_lower = time.perf_counter() - t0
+    exact = _install_cache_listener()
+    t0 = time.perf_counter()
+    with observe.span("jax.compile", category="jax", **attrs) as csp:
+        events: List[str] = []
+        before = None if exact else _persistent_cache_entries()
+        if exact:
+            _mon_tls.events = events
+        try:
+            compiled = (
+                lowered.compile(compiler_options=opts)
+                if opts is not None else lowered.compile()
+            )
+        finally:
+            if exact:
+                _mon_tls.events = None
+        if not getattr(jax.config, "jax_compilation_cache_dir", None):
             outcome = "uncached"  # no persistent cache dir configured
-        elif after != before:
-            outcome = "miss"
-        elif before:
-            outcome = "hit"
+        elif exact:
+            outcome = "hit" if _HIT_EVENT in events else "miss"
         else:
-            # Empty cache cannot hit; the entry was just too fast/small
-            # to persist (same boundary bench.py's warm stamp documents).
-            outcome = "miss"
+            # Monitoring-less jax: the legacy directory differencing
+            # (exact serially; approximate if compiles run concurrently).
+            after = _persistent_cache_entries()
+            outcome = "miss" if (after != before or not before) else "hit"
         csp.set(cache=outcome)
-        observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
+        if observe.enabled():
+            observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
+    return compiled, t_lower, time.perf_counter() - t0, outcome
+
+
+def _run_init(init_fn, key, out_shardings=None):
+    """Monolithic engine: one program, lower → compile → execute.
+
+    Returns with the values RESIDENT (block_until_ready) — both engines
+    share that contract so "materialized" means landed, the execute span
+    and ``last_run_stats`` report true device time, and the pipelined
+    overlap accounting stays honest.  Init is a once-per-process path;
+    async-dispatch overlap with later host code bought nothing real."""
+    _maybe_enable_cache()
+    t_wall = time.perf_counter()
+    compiled, t_lower, t_compile, outcome = _compile_program(
+        init_fn, key, out_shardings
+    )
+    t0 = time.perf_counter()
     with observe.span("jax.execute", category="jax") as esp:
         out = compiled(key)
         esp.block_on(out)
+    jax.block_until_ready(out)
+    t_exec = time.perf_counter() - t0
+    _set_run_stats(
+        mode="monolithic", n_programs=1, workers=1,
+        lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
+        wall_s=time.perf_counter() - t_wall,
+        overlap=1.0, cache={outcome: 1},
+    )
     return out
+
+
+def _pipeline_workers() -> int:
+    """Compile-worker count: TDX_COMPILE_WORKERS, else sized from the
+    host (floor 4 — even a small host overlaps async dispatch with
+    GIL-free compile; the floor keeps the program split, which wins on
+    compile superlinearity alone, from degenerating to one bin)."""
+    from .. import config
+
+    w = config.get().compile_workers
+    if w > 0:
+        return w
+    return max(4, min(8, os.cpu_count() or 1))
+
+
+def _pipeline_max_programs(n_nodes: int) -> int:
+    """Program-count target, a function of the RECORDING alone (never of
+    the host): finer splits for big recordings — XLA compile is
+    superlinear in module size, so large models want small programs
+    (~48 nodes each) even when compiles run serially — floored at 8 so
+    a worker pool has slack, capped so per-program fixed cost (jit
+    dispatch, cache key/put) stays negligible.  Host-independence is a
+    contract: ``tools/warm_cache.py`` may warm the cache on a login host
+    with a different core count than the consumer, and the warmed
+    program set must still match exactly."""
+    return min(32, max(8, n_nodes // 48))
+
+
+# Below this many recorded nodes a model's compile time is dominated by
+# fixed per-program overhead (~tens of ms each on CPU), so splitting it
+# can only lose; the pipelined engine falls back to the monolith.
+_PIPELINE_MIN_NODES = 32
+
+
+def _plan_pipeline(fake_list) -> Optional[List[List[int]]]:
+    """The per-group program split for ``fake_list``, or None when the
+    pipelined engine would not help (single group, or model too small)."""
+    from .compile import collect_nodes
+
+    nodes = collect_nodes(fake_list)
+    if len(nodes) < _PIPELINE_MIN_NODES:
+        return None
+    bins = split_init_groups(
+        fake_list,
+        max_programs=_pipeline_max_programs(len(nodes)),
+        nodes=nodes,
+    )
+    return bins if len(bins) >= 2 else None
+
+
+def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
+                        cast_mask):
+    """Pipelined engine: concurrent per-group build/lower/compile on a
+    worker pool, execution dispatched as each executable lands.
+
+    Workers overlap three ways: Python tracing of group B proceeds while
+    group A sits in GIL-free XLA compilation; compiles of several groups
+    run truly concurrently on multi-core hosts; and the dispatcher's
+    execute of finished groups (async device work) overlaps the remaining
+    compiles.  Outputs stream straight into their planned NamedShardings
+    — there is no gather or reorder step, each slot is written once."""
+    from .. import config
+
+    _maybe_enable_cache()
+    workers = _pipeline_workers()
+    results: List = [None] * len(fake_list)
+    outcomes: Dict[str, int] = {}
+    # The caller's effective config, re-entered on every worker thread:
+    # override() scopes are thread-local, and a worker resolving the
+    # BASE config instead would break both per-scope telemetry
+    # activation and — worse — tracing-time knobs like rng_chunk_elems,
+    # whose divergence between engines would break bitwise parity.
+    eff_cfg = config.get()
+
+    def build_and_compile(gi: int, idxs: List[int]):
+        sub = [fake_list[i] for i in idxs]
+        with config.bind(eff_cfg), observe.span(
+            "jax.pipeline.group", category="jax", group=gi,
+            n_outputs=len(sub),
+        ):
+            fn = build_init_fn(sub)
+            if param_dtype is not None:
+                fn = _cast_outputs(
+                    fn, param_dtype, [cast_mask[i] for i in idxs]
+                )
+            osh = (
+                tuple(out_shardings[i] for i in idxs)
+                if out_shardings is not None else None
+            )
+            return _compile_program(fn, key, osh, label=gi)
+
+    t_wall = time.perf_counter()
+    t_lower = t_compile = t_exec = 0.0
+    with observe.span(
+        "jax.pipeline", category="jax", n_programs=len(bins), workers=workers
+    ) as psp:
+        pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="tdx-compile"
+        )
+        try:
+            futs = {
+                pool.submit(build_and_compile, gi, idxs): (gi, idxs)
+                for gi, idxs in enumerate(bins)
+            }
+            for fut in as_completed(futs):
+                gi, idxs = futs[fut]
+                compiled, tl, tc, outcome = fut.result()
+                t_lower += tl
+                t_compile += tc
+                outcomes[outcome] = outcomes.get(outcome, 0) + 1
+                t0 = time.perf_counter()
+                with observe.span("jax.execute", category="jax", group=gi):
+                    outs = compiled(key)  # async dispatch; lands sharded
+                t_exec += time.perf_counter() - t0
+                for i, v in zip(idxs, outs):
+                    results[i] = v
+        except BaseException:
+            pool.shutdown(wait=True, cancel_futures=True)
+            raise
+        pool.shutdown(wait=True)
+        # The dispatch loop above never blocked: execute_s is dispatch
+        # plus this residual device wait — the execution time NOT hidden
+        # behind compilation (per-program device busy time is not
+        # observable without serializing on per-group blocks).
+        t0 = time.perf_counter()
+        jax.block_until_ready(results)
+        t_exec += time.perf_counter() - t0
+        wall = time.perf_counter() - t_wall
+        busy = t_lower + t_compile + t_exec
+        overlap = busy / wall if wall > 0 else 1.0
+        psp.set(overlap=round(overlap, 3), cache=dict(outcomes))
+        if observe.enabled():
+            observe.gauge("tdx.jax.pipeline_overlap").set(round(overlap, 3))
+    _set_run_stats(
+        mode="pipelined", n_programs=len(bins), workers=workers,
+        lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
+        wall_s=wall, overlap=round(overlap, 3), cache=outcomes,
+    )
+    return tuple(results)
+
+
+def _materialize_values(fake_list, out_shardings, seed, param_dtype,
+                        cast_mask):
+    """The ONE instrumented materialization core both public entry points
+    share: engine selection (monolithic vs pipelined), the
+    ``jax.materialize`` span, and bytes / GB/s accounting."""
+    from .. import config
+
+    t0 = time.perf_counter()
+    with observe.span(
+        "jax.materialize", category="jax", n_outputs=len(fake_list),
+        backend=jax.default_backend() if observe.enabled() else None,
+    ) as sp, gc_paused():
+        mode = config.get().materialize_pipeline
+        if mode not in ("off", "auto"):
+            raise ValueError(
+                f"TDX_MATERIALIZE_PIPELINE={mode!r}: expected 'off' or 'auto'"
+            )
+        bins = _plan_pipeline(fake_list) if mode == "auto" else None
+        key = jax.random.PRNGKey(seed)
+        if bins is None:
+            init_fn = _cast_outputs(
+                build_init_fn(fake_list), param_dtype, cast_mask
+            )
+            values = _run_init(init_fn, key, out_shardings)
+        else:
+            values = _run_init_pipelined(
+                fake_list, bins, key, out_shardings, param_dtype, cast_mask
+            )
+        if observe.enabled():
+            # Both engines block before returning, so this is a
+            # bookkeeping pass, not a second sync.
+            n_bytes = sum(int(v.size) * v.dtype.itemsize for v in values)
+            dt = time.perf_counter() - t0
+            gbps = n_bytes / dt / 1e9  # unrounded: toy models are ~1e-6
+            sp.set(bytes=n_bytes, gbps=gbps)
+            observe.counter("tdx.jax.bytes_materialized").inc(n_bytes)
+            observe.gauge("tdx.jax.materialize_gbps").set(gbps)
+    return values
 
 
 def named_fake_tensors(module: torch.nn.Module) -> Dict[str, torch.Tensor]:
@@ -228,25 +547,34 @@ def _named_entries(module: torch.nn.Module) -> Iterator[Tuple[str, torch.Tensor]
     yield from module.named_buffers(remove_duplicate=False)
 
 
+def _names_and_shardings(
+    fakes: Dict[str, torch.Tensor],
+    mesh: Optional[Mesh],
+    plan: Optional[ShardingPlan],
+):
+    """(names, fake_list, out_shardings) for a fake dict — the single
+    place the plan-to-NamedSharding mapping lives, so lowered, live, and
+    pipelined materialization can never diverge."""
+    names = list(fakes.keys())
+    fake_list = [fakes[n] for n in names]
+    out_shardings = None
+    if mesh is not None:
+        plan = plan or ShardingPlan()
+        out_shardings = plan.shardings_for(
+            names, [tuple(f.shape) for f in fake_list], mesh
+        )
+    return names, fake_list, out_shardings
+
+
 def _init_and_shardings(
     fakes: Dict[str, torch.Tensor],
     mesh: Optional[Mesh],
     plan: Optional[ShardingPlan],
 ):
     """Shared plumbing: (names, init_fn, out_shardings) for a fake dict —
-    the single place the plan-to-NamedSharding mapping lives, so lowered
-    and live materialization can never diverge."""
-    names = list(fakes.keys())
-    fake_list = [fakes[n] for n in names]
-    init_fn = build_init_fn(fake_list)
-    out_shardings = None
-    if mesh is not None:
-        plan = plan or ShardingPlan()
-        out_shardings = tuple(
-            NamedSharding(mesh, plan.spec_for(n, tuple(f.shape), mesh))
-            for n, f in zip(names, fake_list)
-        )
-    return names, init_fn, out_shardings
+    the monolithic program the export/lowering paths ship."""
+    names, fake_list, out_shardings = _names_and_shardings(fakes, mesh, plan)
+    return names, build_init_fn(fake_list), out_shardings
 
 
 def materialize_params_jax(
@@ -259,11 +587,12 @@ def materialize_params_jax(
 ) -> Dict[str, jax.Array]:
     """Materialize a dict of fake tensors as (sharded) jax.Arrays.
 
-    One XLA program computes all requested tensors; with ``mesh`` + ``plan``
+    One or several XLA programs (see the engine note in the module
+    docstring) compute all requested tensors; with ``mesh`` + ``plan``
     each output lands directly in device memory with its planned
     ``NamedSharding``.  RNG uses per-op keys (fold_in of ``seed`` and the
-    recorded op number), so results are independent of sharding layout and
-    materialization order.
+    recorded op number), so results are independent of sharding layout,
+    program split, and materialization order.
 
     ``param_dtype`` (e.g. ``jnp.bfloat16``) casts floating
     ``nn.Parameter`` entries inside the compiled program — init
@@ -275,26 +604,11 @@ def materialize_params_jax(
     """
     # Tracing/interpreting the graph allocates like recording does
     # (Box/lens objects, jaxpr eqns); same GC pause, same rationale.
-    t0 = time.perf_counter()
-    with observe.span(
-        "jax.materialize", category="jax", n_outputs=len(fakes),
-        backend=jax.default_backend() if observe.enabled() else None,
-    ) as sp, gc_paused():
-        names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
-        if param_dtype is not None:
-            mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
-            init_fn = _cast_outputs(init_fn, param_dtype, mask)
-        values = _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)
-        if observe.enabled():
-            # _run_init's execute span already blocked, so this is a
-            # bookkeeping pass, not a second sync.
-            jax.block_until_ready(values)
-            n_bytes = sum(int(v.size) * v.dtype.itemsize for v in values)
-            dt = time.perf_counter() - t0
-            gbps = n_bytes / dt / 1e9  # unrounded: toy models are ~1e-6
-            sp.set(bytes=n_bytes, gbps=gbps)
-            observe.counter("tdx.jax.bytes_materialized").inc(n_bytes)
-            observe.gauge("tdx.jax.materialize_gbps").set(gbps)
+    names, fake_list, out_shardings = _names_and_shardings(fakes, mesh, plan)
+    mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+    values = _materialize_values(
+        fake_list, out_shardings, seed, param_dtype, mask
+    )
     return dict(zip(names, values))
 
 
@@ -308,17 +622,20 @@ def materialize_tensor_jax(
 ) -> jax.Array:
     """Materialize one fake tensor as a (sharded) jax.Array.
 
+    Runs through the same instrumented core as the module entry points
+    (``jax.materialize`` span, bytes/GB/s accounting, engine selection).
     ``param_dtype`` casts the result inside the compiled program when it
     is floating — the tensor is named explicitly here, so no
     parameter-vs-buffer distinction applies (unlike the module entry
     points, which never cast buffers)."""
     if not is_fake(tensor):
         raise ValueError("`tensor` is not fake; nothing to materialize.")
-    init_fn = _cast_outputs(build_init_fn([tensor]), param_dtype)
     out_shardings = None
     if mesh is not None:
         out_shardings = (NamedSharding(mesh, spec or PartitionSpec()),)
-    return _run_init(init_fn, jax.random.PRNGKey(seed), out_shardings)[0]
+    return _materialize_values(
+        [tensor], out_shardings, seed, param_dtype, [True]
+    )[0]
 
 
 def lower_init_module(
@@ -361,6 +678,53 @@ def lower_init_module(
     with observe.span("jax.lower", category="jax", n_outputs=len(names)):
         lowered = jitted.lower(jax.random.PRNGKey(0))
     return lowered, names
+
+
+def lower_init_groups(
+    module: torch.nn.Module,
+    *,
+    mesh: Optional[Mesh] = None,
+    plan: Optional[ShardingPlan] = None,
+    param_dtype=None,
+    max_programs: Optional[int] = None,
+):
+    """Per-group lowered init programs — the exact program set the
+    pipelined engine will compile for this module under the current
+    config (same split policy, same out_shardings, same cast masks).
+
+    Yields ``(lowered, names)`` per group.  ``tools/warm_cache.py``
+    compiles these (plus the whole-model program) into the persistent
+    cache on a login host so pod-scale cold starts become cache hits;
+    returns an empty list when the model is below the pipeline threshold
+    (the engine would run monolithic — warm that via
+    :func:`lower_init_module`)."""
+    fakes = named_fake_tensors(module)
+    names, fake_list, out_shardings = _names_and_shardings(fakes, mesh, plan)
+    mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+    if max_programs is None:
+        bins = _plan_pipeline(fake_list)
+    else:
+        bins = split_init_groups(fake_list, max_programs=max_programs)
+        if len(bins) < 2:
+            bins = None
+    out = []
+    key = jax.random.PRNGKey(0)
+    for idxs in bins or []:
+        fn = build_init_fn([fake_list[i] for i in idxs])
+        if param_dtype is not None:
+            fn = _cast_outputs(fn, param_dtype, [mask[i] for i in idxs])
+        osh = (
+            tuple(out_shardings[i] for i in idxs)
+            if out_shardings is not None else None
+        )
+        jitted = (
+            jax.jit(fn, out_shardings=osh) if osh is not None else jax.jit(fn)
+        )
+        with observe.span(
+            "jax.lower", category="jax", n_outputs=len(idxs)
+        ):
+            out.append((jitted.lower(key), [names[i] for i in idxs]))
+    return out
 
 
 def materialize_module_jax(

@@ -49,6 +49,14 @@ spans from :mod:`..utils.checkpoint`, ``tdx.elastic.restarts`` /
 ``.watchdog_kills`` / ``.drains``, ``tdx.ckpt.verify_fail`` /
 ``.quarantined``, and ``tdx.chaos.injected{kind=...}`` counters from
 :mod:`..utils.failures` and :mod:`..chaos`.
+
+So does the overlapped materialization engine (docs/performance.md):
+``jax.pipeline`` / ``jax.pipeline.group`` spans around the concurrent
+per-group compiles, the ``tdx.jax.pipeline_overlap`` gauge (busy/wall;
+> 1 means trace, compile, and execute genuinely overlapped), and the
+``tdx.jax.compile_cache_*`` counters — which stay EXACT under concurrent
+compiles because the oracle is jax's monitoring stream attributed per
+compiling thread, not cache-directory differencing.
 """
 
 from __future__ import annotations

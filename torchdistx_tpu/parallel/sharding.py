@@ -53,6 +53,21 @@ class ShardingPlan:
     def sharding_for(self, name: str, shape: Sequence[int], mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, self.spec_for(name, shape, mesh))
 
+    def shardings_for(
+        self,
+        names: Sequence[str],
+        shapes: Sequence[Sequence[int]],
+        mesh: Mesh,
+    ) -> Tuple[NamedSharding, ...]:
+        """The planned ``NamedSharding`` per (name, shape) pair, in order —
+        the batch form every materialization engine consumes as
+        ``out_shardings`` (monolithic, per-group pipelined, and lowered
+        export all pass through here, so their plan resolution cannot
+        diverge)."""
+        return tuple(
+            self.sharding_for(n, s, mesh) for n, s in zip(names, shapes)
+        )
+
 
 def _axis_size(mesh: Mesh, axis: Union[str, Tuple[str, ...], None]) -> int:
     if axis is None:
