@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -48,7 +48,20 @@ test:
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
 chaos-test:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_failures.py -q -p no:cacheprovider
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+	    tests/test_materialize_chaos.py tests/test_failures.py \
+	    -q -p no:cacheprovider
+
+# One short materialize-recovery soak cycle under tier-1 constraints
+# (CPU, bounded wall clock): drives the self-healing materialization
+# ladder end-to-end through tools/soak.py with a fixed fault plan —
+# compile failure + slow execute survived bitwise on every seed.  The
+# randomized long-running companion is `tools/soak.py --modes
+# materialize --seconds 3600` (docs/robustness.md).
+soak-smoke:
+	JAX_PLATFORMS=cpu timeout -k 10 420 python tools/soak.py \
+	    --modes materialize --seconds 120 --seeds 4 --workers 2 \
+	    --start 910000 --fault-plan 'compile@1=raise;execute@2=slow:0.1'
 
 # Fast CPU slice of bench.py under tier-1 constraints, so materialize-
 # path regressions fail in CI instead of only in nightly bench: the

@@ -355,3 +355,131 @@ class TestCrossProcessResume:
             if n.startswith("step_")
         )
         assert steps_on_disk == [6, 8]
+
+
+class TestDrainIntegrity:
+    """ROADMAP open item: a drain checkpoint that fails verification was
+    quarantined — yet CLEAN_EXIT.json was still written and exit_on_drain
+    still exited 0, breaking the lossless-resume contract.  The drain
+    must refuse the clean-exit promise it cannot keep."""
+
+    def _run_with_corrupt_drain_save(self, tmp_path, monkeypatch,
+                                     **elastic_kw):
+        import os
+        import signal
+
+        import jax.numpy as jnp
+
+        from torchdistx_tpu.utils import checkpoint as ckpt
+
+        real_verify = ckpt.verify_checkpoint
+
+        def corrupt_step3_verify(path, **kw):
+            if os.path.basename(str(path)) == "step_3":
+                return False, "injected drain corruption"
+            return real_verify(path, **kw)
+
+        monkeypatch.setattr(ckpt, "verify_checkpoint", corrupt_step3_verify)
+
+        def step(state, batch):
+            if int(batch) == 3:  # the announced preemption notice
+                os.kill(os.getpid(), signal.SIGTERM)
+            return {"x": state["x"] + batch}, {}
+
+        return run_elastic(
+            step, {"x": jnp.float32(0.0)},
+            [jnp.float32(i) for i in range(1, 7)],
+            checkpoint_dir=str(tmp_path), checkpoint_every=100,
+            probe_on_restart=False, **elastic_kw,
+        )
+
+    def test_corrupt_drain_save_blocks_clean_exit_marker(
+        self, tmp_path, monkeypatch
+    ):
+        from torchdistx_tpu import observe
+        from torchdistx_tpu.utils.failures import CLEAN_EXIT_MARKER
+
+        before = observe.counters().counter("tdx.elastic.drain_failures").value
+        out, steps, _ = self._run_with_corrupt_drain_save(
+            tmp_path, monkeypatch
+        )
+        assert steps == 3  # drained after finishing the step
+        # The quarantined drain save must NOT advertise a clean exit.
+        assert not (tmp_path / CLEAN_EXIT_MARKER).exists()
+        assert (tmp_path / "step_3.corrupt").is_dir()
+        assert observe.counters().counter(
+            "tdx.elastic.drain_failures").value == before + 1
+
+        # Resume falls back to the previous VERIFIED checkpoint (step_0)
+        # and replays to completion bit-exactly.
+        import jax.numpy as jnp
+        import numpy as np
+
+        out2, steps2, _ = run_elastic(
+            lambda s, b: ({"x": s["x"] + b}, {}),
+            {"x": jnp.float32(0.0)},
+            [jnp.float32(i) for i in range(1, 7)],
+            checkpoint_dir=str(tmp_path), checkpoint_every=100,
+            resume=True, probe_on_restart=False,
+        )
+        assert steps2 == 6
+        assert float(out2["x"]) == float(np.float32(sum(range(1, 7))))
+
+    def test_corrupt_drain_save_exits_nonzero(self, tmp_path, monkeypatch):
+        with pytest.raises(SystemExit) as ei:
+            self._run_with_corrupt_drain_save(
+                tmp_path, monkeypatch, exit_on_drain=True
+            )
+        assert ei.value.code == 1  # NOT the relauncher's resume signal
+
+
+class TestStuckProbeLocking:
+    def test_concurrent_health_checks_race_free(self, monkeypatch):
+        """ROADMAP open item: _STUCK_PROBES was mutated without a lock
+        although device_health is documented for concurrent
+        FailureDetector use.  N concurrent checks against a wedged
+        device must each report unhealthy and register at most ONE
+        abandoned probe per device."""
+        import threading
+        import time as _time
+
+        import torchdistx_tpu.utils.failures as F
+
+        real_put = jax.device_put
+
+        def wedged_put(x, d):
+            _time.sleep(1.2)
+            return real_put(x, d)
+
+        monkeypatch.setattr(jax, "device_put", wedged_put)
+        reports = []
+
+        def check():
+            reports.append(F.device_health(deadline=0.15))
+
+        threads = [threading.Thread(target=check) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(reports) == 4
+            assert all(not r["healthy"] for r in reports)
+            with F._stuck_probes_lock:
+                per_device = dict(F._STUCK_PROBES)
+            assert set(per_device) <= {d.id for d in jax.devices()}
+            # THE invariant: one abandoned probe thread per wedged
+            # device, not one per concurrent caller — the per-device
+            # probe lock serializes check→probe→register, so callers
+            # 2..4 see the stuck entry instead of spawning their own.
+            probes = [t for t in threading.enumerate()
+                      if t.name.startswith("tdx-health-probe-")]
+            assert len(probes) <= len(jax.devices())
+            names = [t.name for t in probes]
+            assert len(names) == len(set(names))  # no duplicate device
+        finally:
+            monkeypatch.undo()
+            deadline = _time.time() + 5.0
+            while F._STUCK_PROBES and _time.time() < deadline:
+                F.device_health(deadline=2.0)  # healthy probe clears entries
+                _time.sleep(0.05)

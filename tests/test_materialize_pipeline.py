@@ -320,3 +320,53 @@ class TestWarmCacheTool:
                  "--skip-whole"])
         out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert out["programs"] >= 2 and out["cache_entries"] > 0
+
+    def test_unwritable_cache_dir_fails_loudly(self, tmp_path, telemetry):
+        # jax degrades cache-WRITE errors to warnings, so without the
+        # upfront probe the tool would burn the compile budget and then
+        # claim success having warmed nothing.  A path that is a regular
+        # file is unwritable-as-a-directory for any uid (root included).
+        wc = self._load_tool()
+        not_a_dir = tmp_path / "cache_file"
+        not_a_dir.write_text("occupied")
+        with pytest.raises(OSError, match="not writable"):
+            wc.warm(wc._demo_model, str(not_a_dir))
+        # The failed warm must not leave a cache binding behind: a later
+        # materialize with no cache configured reports uncached.
+        with tdx_config.override(cache_dir=None):
+            _, st = _materialize(wc._demo_model, "off")
+        assert list(st["cache"]) == ["uncached"]
+
+    def test_interrupted_warm_leaves_cache_usable(self, fresh_cache,
+                                                  monkeypatch):
+        # Interrupt the warm after the whole-model program but before the
+        # per-group set: the partial cache must stay USABLE — each entry
+        # commits independently, so a torn warm is "fewer hits", never a
+        # poisoned dir that later compiles trip over.
+        wc = self._load_tool()
+
+        def boom(*a, **k):
+            raise RuntimeError("interrupted warm (injected)")
+
+        monkeypatch.setattr(mat, "lower_init_groups", boom)
+        with pytest.raises(RuntimeError, match="interrupted warm"):
+            wc.warm(wc._demo_model, fresh_cache)
+        monkeypatch.undo()
+        assert len(os.listdir(fresh_cache)) >= 1  # the whole-model entry
+
+        # The partial cache serves what it has: off-mode (the program the
+        # interrupted warm DID commit) all-hits...
+        mat._reset_cache_binding()
+        with tdx_config.override(cache_dir=fresh_cache):
+            _, st = _materialize(wc._demo_model, "off", workers=2)
+        assert st["cache"] == {"hit": 1}
+
+        # ...and a rerun of the warm completes the set — no quarantines,
+        # no stale junk in the way — after which both engines all-hit.
+        summary = wc.warm(wc._demo_model, fresh_cache)
+        assert summary["programs"] >= 3
+        for mode in ("auto", "off"):
+            mat._reset_cache_binding()
+            with tdx_config.override(cache_dir=fresh_cache):
+                _, st = _materialize(wc._demo_model, mode, workers=2)
+            assert list(st["cache"]) == ["hit"], (mode, st["cache"])

@@ -22,6 +22,17 @@ accelerator runtime:
     python tools/soak.py --modes elastic --seconds 600 \\
         --fault-plan 'save@2=corrupt:truncate;step@3=raise'
 
+The ``materialize`` mode soaks the self-healing materialization pipeline
+the same way: each seed deferred-inits a randomized heterogeneous model,
+injects a fault plan into the record→compile→execute pipeline (sites
+``lower``/``compile``/``execute``/``cache``, including real on-disk
+compile-cache corruption and SIGTERM preemption drains), retries through
+the partial-progress resume contract, and asserts the final materialized
+parameters are bitwise-equal to the fault-free run:
+
+    python tools/soak.py --modes materialize --seconds 300 \\
+        --fault-plan 'compile@1=raise;cache@2=corrupt:truncate'
+
 Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
 exception) and the exit code is non-zero if any occurred.
 """
@@ -39,7 +50,7 @@ import traceback
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
-         "geom", "geom_single", "geom_bridge", "elastic")
+         "geom", "geom_single", "geom_bridge", "elastic", "materialize")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -53,6 +64,9 @@ def _init_worker(fault_plan: "str | None" = None,
     # One thread per worker: the fuzz tensors are tiny, and N workers ×
     # ncpu intra-op threads would oversubscribe the box.
     os.environ["OMP_NUM_THREADS"] = "1"
+    # The materialize oracle's models compile in milliseconds; persist
+    # them anyway so cache-corruption faults have real entries to damage.
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
     if platform == "default":
         # --platform default (elastic-only soaks under a tpu_watch
         # window): leave the backend alone so recovery is exercised
@@ -144,6 +158,100 @@ def _elastic_oracle(seed: int, plan_text: "str | None"):
     return None
 
 
+def _materialize_oracle(seed: int, plan_text: "str | None"):
+    """One self-healing materialization run: inject a fault plan into the
+    record→compile→execute pipeline over a seeded heterogeneous model and
+    assert the final materialized parameters are bitwise-equal to the
+    fault-free run — surviving raises, hangs (via the compile watchdog),
+    slow stages, on-disk compile-cache corruption, and SIGTERM preemption
+    drains resumed through the partial-progress manifest."""
+    import random
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import torch
+
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu.deferred_init import deferred_init
+    from torchdistx_tpu.jax_bridge import (
+        MaterializationError,
+        materialize_module_jax,
+    )
+    from torchdistx_tpu.jax_bridge import materialize as mat
+
+    rng = random.Random(seed)
+    k = rng.randrange(9, 13)
+    widths = [8 + 4 * rng.randrange(1, 8) for _ in range(k)]
+
+    class Model(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = torch.nn.ModuleList(
+                torch.nn.Linear(widths[i], widths[(i + 1) % k])
+                for i in range(k)
+            )
+
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        site = rng.choice(["lower", "compile", "execute", "cache"])
+        # `corrupt` needs on-disk cache entries; the warm pass below
+        # guarantees them.  `hang` leans on the watchdog deadline.
+        kind = rng.choice(["raise", "hang", "slow", "corrupt", "preempt"])
+        arg = {"hang": ":30", "slow": ":0.1", "corrupt": ":truncate"}.get(
+            kind, "")
+        group = rng.randrange(1, 4)
+        plan = chaos.parse_plan(f"{site}@{group}={kind}{arg}")
+
+    cache_dir = tempfile.mkdtemp(prefix="tdx_soak_mat_cache_")
+    resume_dir = tempfile.mkdtemp(prefix="tdx_soak_mat_resume_")
+    try:
+        module = deferred_init(Model)
+        with tdx_config.override(materialize_pipeline="off"):
+            baseline = {
+                k_: np.asarray(v) for k_, v in
+                materialize_module_jax(module, seed=seed).items()
+            }
+        # Warm pass (also validates the fault-free pipelined run) so
+        # cache-corruption faults have real entries to damage.
+        mat._reset_cache_binding()
+        with tdx_config.override(
+            materialize_pipeline="auto", cache_dir=cache_dir,
+            compile_workers=2,
+        ):
+            materialize_module_jax(module, seed=seed)
+
+        chaos.install(plan)
+        params = None
+        with tdx_config.override(
+            materialize_pipeline="auto", cache_dir=cache_dir,
+            compile_workers=2, compile_deadline_s=5.0,
+            materialize_retries=2, materialize_resume_dir=resume_dir,
+        ):
+            mat._reset_cache_binding()
+            for _attempt in range(4):  # drain / resume contract
+                try:
+                    params = materialize_module_jax(module, seed=seed)
+                    break
+                except MaterializationError:
+                    continue
+        if params is None:
+            return ("error", f"did not materialize after 4 attempts "
+                             f"plan={plan!r}")
+        for name, want in baseline.items():
+            got = np.asarray(params[name])
+            if not np.array_equal(want, got):
+                return ("mismatch", f"{name} differs plan={plan!r}")
+    finally:
+        chaos.clear()
+        mat._reset_cache_binding()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(resume_dir, ignore_errors=True)
+    return None
+
+
 def _run_seed(mode: str, seed: int):
     """Run one oracle; returns None on pass/skip, (kind, message) else."""
     import random
@@ -193,6 +301,10 @@ def _run_seed(mode: str, seed: int):
             r = _elastic_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
+        elif mode == "materialize":
+            r = _materialize_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "serialize":
             import tempfile
             from pathlib import Path
@@ -231,9 +343,10 @@ def main() -> int:
     ap.add_argument("--log", default=os.path.join(REPO, "tools",
                                                   "soak_failures.jsonl"))
     ap.add_argument("--fault-plan", default=None,
-                    help="chaos plan for --modes elastic (grammar: "
-                         "torchdistx_tpu.chaos / docs/robustness.md); "
-                         "default: a seeded-random plan per seed")
+                    help="chaos plan for --modes elastic/materialize "
+                         "(grammar: torchdistx_tpu.chaos / "
+                         "docs/robustness.md); default: a seeded-random "
+                         "plan per seed")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",
                     help="jax backend for elastic-only soaks: 'default' "
                          "soaks recovery on the real accelerator "

@@ -143,6 +143,22 @@ def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
     from torchdistx_tpu.deferred_init import deferred_init
     from torchdistx_tpu.jax_bridge import materialize as mat
 
+    # Fail fast on an unusable cache dir: jax itself degrades cache-WRITE
+    # errors to warnings, so without this probe the tool would burn the
+    # full compile budget and then claim success while having warmed
+    # nothing.  (A permissions probe via os.access lies under root, so
+    # actually write.)
+    probe = os.path.join(cache_dir, f".tdx_warm_probe_{os.getpid()}")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(probe, "w") as f:
+            f.write("probe")
+        os.remove(probe)
+    except OSError as e:
+        raise OSError(
+            f"cache dir {cache_dir!r} is not writable ({e}); nothing warmed"
+        ) from e
+
     # The tool exists to persist: never let jax's 0.1 s min-compile-time
     # threshold silently skip writing the fast-compiling group programs
     # this run claims to have warmed (explicit env wins; the prior value

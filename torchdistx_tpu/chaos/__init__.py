@@ -29,11 +29,19 @@ Fault kinds and what they model:
              accelerator wedge for an entire round)
 ``corrupt``  post-commit checkpoint damage (truncate or bit-flip) — the
              half-written / bit-rotted checkpoint a naive resume crashes
-             on
+             on; at the materialization sites (``lower`` / ``compile`` /
+             ``execute`` / ``cache``) it damages the persistent XLA
+             compile-cache entries on disk instead (the poisoned-cache
+             model)
 ``slow``     a save that takes extra seconds — checkpoint latency
              hiding the preemption deadline
 ``preempt``  SIGTERM to self — the *announced* preemption notice
 ===========  ==========================================================
+
+The materialization sites fire inside the record→compile→materialize
+pipeline (:mod:`torchdistx_tpu.jax_bridge.materialize`), keyed by the
+1-based program-group number instead of the training step (the
+monolithic engine is group 1); see docs/robustness.md.
 """
 
 from __future__ import annotations
@@ -43,6 +51,7 @@ from typing import List, Optional, Union
 
 from .inject import (
     InjectedRuntimeError,
+    corrupt_cache_dir,
     corrupt_checkpoint,
     execute,
     set_cancel_event,
@@ -57,6 +66,7 @@ __all__ = [
     "SITES",
     "active_plan",
     "clear",
+    "corrupt_cache_dir",
     "corrupt_checkpoint",
     "install",
     "maybe_inject",

@@ -30,6 +30,9 @@ from .plan import Fault
 
 _HANG_DEFAULT_S = 3600.0  # "never returns" at test scale; watchdog-killable
 _CORRUPT_MODES = ("truncate", "flip")
+# Materialization-pipeline sites: `corrupt` there damages the persistent
+# XLA compile cache (path = the cache dir), not a checkpoint directory.
+_CACHE_SITES = ("lower", "compile", "execute", "cache")
 
 
 class InjectedRuntimeError(RuntimeError):
@@ -100,10 +103,57 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
         return
     if fault.kind == "corrupt":
         if path is None:
-            raise ValueError(f"corrupt fault needs a checkpoint path: {fault.spec()}")
-        corrupt_checkpoint(path, mode=fault.arg or "truncate")
+            raise ValueError(
+                f"corrupt fault needs a target path (checkpoint dir, or the "
+                f"persistent compile-cache dir at materialization sites): "
+                f"{fault.spec()}"
+            )
+        if fault.site in _CACHE_SITES:
+            corrupt_cache_dir(path, mode=fault.arg or "truncate")
+        else:
+            corrupt_checkpoint(path, mode=fault.arg or "truncate")
         return
     raise AssertionError(f"unreachable fault kind {fault.kind!r}")
+
+
+def _damage_file(f: Path, mode: str) -> None:
+    """Apply one deterministic byte-level damage mode to ``f`` in place."""
+    if mode == "truncate":
+        size = f.stat().st_size
+        with open(f, "r+b") as fh:
+            fh.truncate(max(0, size // 2))
+        return
+    with open(f, "r+b") as fh:  # flip
+        data = bytearray(fh.read())
+        if not data:
+            raise ValueError(f"cannot flip a byte of empty file {f}")
+        # Deterministic victim byte: keyed by content, not RNG.
+        i = zlib.crc32(bytes(data)) % len(data)
+        data[i] ^= 0xFF
+        fh.seek(0)
+        fh.write(data)
+
+
+def corrupt_cache_dir(path: "str | Path", mode: str = "truncate") -> "list[str]":
+    """Deterministically damage EVERY entry of a persistent XLA
+    compile-cache directory (the poisoned-cache model: bit rot or a torn
+    write under a compile that another process later loads).  All entries
+    are damaged, not one, so the injection stays deterministic however the
+    concurrent compile workers interleave with it — whichever group loads
+    next must hit a corrupt entry.  Returns the damaged entry filenames.
+    """
+    if mode not in _CORRUPT_MODES:
+        raise ValueError(f"corrupt mode must be one of {_CORRUPT_MODES}, got {mode!r}")
+    path = Path(path)
+    victims = sorted(
+        f for f in path.iterdir()
+        if f.is_file() and f.name.endswith("-cache")
+    ) if path.is_dir() else []
+    if not victims:
+        raise FileNotFoundError(f"no compile-cache entries to corrupt under {path}")
+    for f in victims:
+        _damage_file(f, mode)
+    return [f.name for f in victims]
 
 
 def corrupt_checkpoint(path: "str | Path", mode: str = "truncate") -> str:
@@ -125,19 +175,5 @@ def corrupt_checkpoint(path: "str | Path", mode: str = "truncate") -> str:
     if not victims:
         raise FileNotFoundError(f"no payload files to corrupt under {path}")
     rel = victims[-1]
-    f = path / rel
-    if mode == "truncate":
-        size = f.stat().st_size
-        with open(f, "r+b") as fh:
-            fh.truncate(max(0, size // 2))
-    else:  # flip
-        with open(f, "r+b") as fh:
-            data = bytearray(fh.read())
-            if not data:
-                raise ValueError(f"cannot flip a byte of empty file {f}")
-            # Deterministic victim byte: keyed by content, not RNG.
-            i = zlib.crc32(bytes(data)) % len(data)
-            data[i] ^= 0xFF
-            fh.seek(0)
-            fh.write(data)
+    _damage_file(path / rel, mode)
     return str(rel)

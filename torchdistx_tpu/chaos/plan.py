@@ -1,15 +1,18 @@
 """Fault-plan grammar and bookkeeping.
 
 A fault plan is a deterministic schedule of failures keyed by *site*
-(where in the elastic loop the fault fires) and *step* (the 1-based
-training step it fires at).  Determinism is the point: every failure the
-recovery stack claims to survive can be replayed exactly, in CI, on CPU.
+(where in the elastic loop or the materialization pipeline the fault
+fires) and *step* (the 1-based training step — or, for the
+materialization sites, the 1-based program-group number — it fires at).
+Determinism is the point: every failure the recovery stack claims to
+survive can be replayed exactly, in CI, on CPU.
 
 Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
 
     plan  := entry (';' entry)*
     entry := site '@' step '=' kind [':' arg] ['x' count]
-    site  := 'step' | 'save' | 'restore'
+    site  := 'step' | 'save' | 'restore'            (elastic loop)
+           | 'lower' | 'compile' | 'execute' | 'cache'  (materialization)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
 
 Examples::
@@ -20,11 +23,17 @@ Examples::
     save@4=corrupt:truncate      # damage the step-4 checkpoint POST-commit
     save@2=slow:0.5              # the step-2 save takes an extra 0.5 s
     step@4=raise x2              # fires the first TWO times step 4 runs
+    compile@1=hang:3600          # group 1's XLA compile wedges (watchdog)
+    cache@1=corrupt:truncate     # damage the on-disk compile-cache entries
 
 Each entry fires ``count`` times (default 1) and is then spent — a
 restarted step re-executes fault-free, which is what makes
 recover-and-converge scenarios terminate.  ``corrupt`` args are
 ``truncate`` (default) or ``flip``; ``hang``/``slow`` args are seconds.
+At the materialization sites ``corrupt`` damages the persistent XLA
+compile-cache entries on disk (the bad-cache-entry model) and the
+"step" is the 1-based program-group number (the monolithic engine is
+group 1); see docs/robustness.md.
 """
 
 from __future__ import annotations
@@ -34,7 +43,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-SITES = ("step", "save", "restore")
+SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
 
 _ENTRY_RE = re.compile(

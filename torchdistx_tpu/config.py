@@ -33,6 +33,26 @@ Environment variables (read at first import):
                         the host's CPU count; XLA compilation releases the
                         GIL, so workers overlap for real on multi-core
                         hosts).
+``TDX_COMPILE_DEADLINE_S``
+                        Watchdog deadline (seconds) for each materialization
+                        stage (lower / compile / execute dispatch): a stage
+                        running longer is abandoned on its worker thread and
+                        retried — a wedged XLA compile can no longer hang
+                        the pipeline (0 disables; see docs/robustness.md).
+``TDX_MATERIALIZE_RETRIES``
+                        Per-STAGE retry budget of the self-healing
+                        materialization ladder — each program's compile
+                        ladder and execute ladder get this many retries
+                        (default 2; the compile ladder's final retry
+                        bypasses the persistent cache so a poisoned entry
+                        cannot fail every attempt).
+``TDX_MATERIALIZE_RESUME_DIR``
+                        Directory for materialization progress manifests:
+                        when set, the pipelined engine commits each
+                        completed group's outputs there, and a rerun after
+                        an interrupted materialization (fault,
+                        ``MaterializationError``, SIGTERM) skips the
+                        already-materialized groups ("" disables).
 ``TDX_LOG_LEVEL``       Logging level name for the framework logger.
 ``TDX_TRACE_DIR``       Directory for runtime telemetry traces: when set,
                         :mod:`torchdistx_tpu.observe` collects spans across
@@ -76,6 +96,9 @@ class Config:
     fault_plan: Optional[str] = None
     materialize_pipeline: str = "auto"
     compile_workers: int = 0
+    compile_deadline_s: float = 0.0
+    materialize_retries: int = 2
+    materialize_resume_dir: Optional[str] = None
 
 
 def _from_env() -> Config:
@@ -90,6 +113,11 @@ def _from_env() -> Config:
         fault_plan=os.environ.get("TDX_FAULT_PLAN", "") or None,
         materialize_pipeline=os.environ.get("TDX_MATERIALIZE_PIPELINE", "auto"),
         compile_workers=int(os.environ.get("TDX_COMPILE_WORKERS", "0")),
+        compile_deadline_s=float(os.environ.get("TDX_COMPILE_DEADLINE_S", "0")),
+        materialize_retries=int(os.environ.get("TDX_MATERIALIZE_RETRIES", "2")),
+        materialize_resume_dir=(
+            os.environ.get("TDX_MATERIALIZE_RESUME_DIR", "") or None
+        ),
     )
 
 

@@ -3,6 +3,9 @@
 from .compile import build_init_fn
 from .export import export_init, load_exported_init, save_exported_init
 from .materialize import (
+    CompileHangError,
+    MaterializationError,
+    lower_init_groups,
     lower_init_module,
     materialize_module_jax,
     materialize_params_jax,
@@ -11,9 +14,12 @@ from .materialize import (
 )
 
 __all__ = [
+    "CompileHangError",
+    "MaterializationError",
     "build_init_fn",
     "export_init",
     "load_exported_init",
+    "lower_init_groups",
     "lower_init_module",
     "save_exported_init",
     "materialize_module_jax",

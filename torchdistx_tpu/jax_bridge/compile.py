@@ -581,6 +581,89 @@ def _node_sig(node: OpNode, local_index: Dict[int, int]):
     )
 
 
+def _tensor_digest(t: torch.Tensor) -> Tuple:
+    arr = to_numpy(t)
+    return ("tensor", arr.shape, str(arr.dtype),
+            hashlib.sha1(arr.tobytes()).hexdigest())
+
+
+def _fp_value_sig(obj, deps, local_index):
+    """Like :func:`_value_sig` but stable ACROSS PROCESSES: a dependency
+    on an early-materialized node outside the local index is signed by
+    its cached output *content*, never by ``id()`` — the resume-manifest
+    fingerprint must mean the same thing in the rerun that consumes it
+    as in the interrupted run that wrote it."""
+    from .._graph import _Dep
+
+    if isinstance(obj, _Dep):
+        node, idx = deps[obj.index]
+        li = local_index.get(id(node))
+        if li is not None:
+            return ("dep", li, idx)
+        if node.materialized and node.outputs is not None and idx < len(node.outputs):
+            out = node.outputs[idx]
+            if isinstance(out, torch.Tensor):
+                return ("extconst",) + _tensor_digest(out)
+            return ("extconst", "py", repr(out))
+        # A live dependency outside the group cannot happen (collect_nodes
+        # unions dependency-closed chains); refuse rather than sign with
+        # an id() that another process could coincidentally reproduce.
+        raise ValueError(
+            f"group fingerprint: unstable external dependency on "
+            f"{node.op.name!r}"
+        )
+    if isinstance(obj, torch.Tensor):
+        return _tensor_digest(obj)
+    if isinstance(obj, (list, tuple)):
+        kind = "list" if isinstance(obj, list) else "tuple"
+        return (kind, tuple(_fp_value_sig(x, deps, local_index) for x in obj))
+    if isinstance(obj, dict):
+        return ("dict", tuple(sorted(
+            (k, _fp_value_sig(v, deps, local_index)) for k, v in obj.items()
+        )))
+    return _value_sig(obj, deps, local_index)
+
+
+def group_fingerprint(fakes: Sequence[FakeTensor]) -> str:
+    """Content fingerprint of the recorded init computation of ``fakes``:
+    op names, argument values, RNG ``key_nr``s, early-materialized
+    constants (by value), and the requested output slots.
+
+    Unlike :func:`_node_sig` (which deliberately excludes ``key_nr`` so
+    structurally identical chains batch together), this digest pins the
+    exact VALUES the group will produce for a given seed, and it is
+    stable across processes — the self-healing materializer keys its
+    partial-progress manifest on it, so a rerun only skips a group whose
+    recorded computation is identical to the one whose outputs were
+    committed (docs/robustness.md)."""
+    nodes = collect_nodes(fakes)
+    local_index = {id(n): j for j, n in enumerate(nodes)}
+    h = hashlib.sha1(b"tdx-group-fp-v1")
+    for n in nodes:
+        if n.materialized and n.outputs is not None:
+            sig: Tuple = ("terminal", tuple(
+                _tensor_digest(o) if isinstance(o, torch.Tensor)
+                else ("py", repr(o))
+                for o in n.outputs
+            ))
+        else:
+            tls = n.op.tls
+            sig = (
+                _op_name(n),
+                _fp_value_sig(n.op.args, n.dependencies, local_index),
+                _fp_value_sig(n.op.kwargs, n.dependencies, local_index),
+                str(tls.default_dtype) if tls is not None else None,
+            )
+        h.update(repr((n.key_nr, sig)).encode())
+    for f in fakes:
+        ctx = get_fake_context(f, CONTEXT_KEY)
+        h.update(repr((
+            local_index.get(id(ctx.node), -1), ctx.output_index,
+            tuple(f.shape), str(f.dtype),
+        )).encode())
+    return h.hexdigest()
+
+
 def _group_uses_rng(rep: List[OpNode], need: List[Tuple[int, int]]) -> bool:
     """Abstractly interpret a representative component (jax.eval_shape — no
     FLOPs, no compile) and report whether any op drew from the RNG.  A

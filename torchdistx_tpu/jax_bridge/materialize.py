@@ -21,27 +21,51 @@ Two engines share one contract (bitwise-identical outputs, chosen by
   ``NamedSharding``s.  Host-side Python trace, XLA compile, and device
   execution overlap instead of serializing — and at scale the split itself
   beats the monolith's superlinear compile even single-threaded.
+
+Both engines are **self-healing** (docs/robustness.md): every stage
+(lower / compile / execute) runs under a bounded-retry ladder with an
+optional watchdog (``TDX_COMPILE_DEADLINE_S``) that abandons a wedged XLA
+compile instead of hanging the pool; corrupt persistent-cache entries are
+quarantined on load (``<key>.corrupt``) and recompiled; a pipelined group
+that exhausts its retries degrades to the monolithic program; and with
+``TDX_MATERIALIZE_RESUME_DIR`` set, completed groups are committed to a
+progress manifest so an interrupted materialization (fault or SIGTERM)
+resumes where it left off instead of re-tracing the whole model.  Total
+failure raises a typed :class:`MaterializationError` carrying which
+groups succeeded.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
+import signal
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor, as_completed
+import zlib
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    ThreadPoolExecutor,
+    wait as _futures_wait,
+)
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
+import numpy as np
 import torch
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from .. import observe
+from .. import chaos, observe
 from .._graph import gc_paused
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
-from .compile import build_init_fn, split_init_groups
+from ..utils.logging import get_logger
+from .compile import build_init_fn, group_fingerprint, split_init_groups
 
 __all__ = [
+    "CompileHangError",
+    "MaterializationError",
     "materialize_tensor_jax",
     "named_fake_tensors",
     "materialize_params_jax",
@@ -50,6 +74,34 @@ __all__ = [
     "lower_init_groups",
     "last_run_stats",
 ]
+
+
+class MaterializationError(RuntimeError):
+    """Materialization failed (or was drained by SIGTERM) after the full
+    degradation ladder: per-stage retries, cache bypass, and — for the
+    pipelined engine — the monolithic-program fallback.
+
+    ``completed_groups`` / ``failed_groups`` are the pipelined engine's
+    group indices that finished / exhausted their ladder (the monolithic
+    engine is the single group ``0``).  ``resumable`` is True when a
+    progress manifest was left under ``TDX_MATERIALIZE_RESUME_DIR`` — a
+    rerun of the same materialization skips the committed groups.
+    ``drained`` marks a SIGTERM drain (the fallback ladder is NOT
+    attempted for a drain: the process is being preempted)."""
+
+    def __init__(self, msg, *, completed_groups=(), failed_groups=(),
+                 resumable=False, drained=False):
+        super().__init__(msg)
+        self.completed_groups = sorted(completed_groups)
+        self.failed_groups = sorted(failed_groups)
+        self.resumable = resumable
+        self.drained = drained
+
+
+class CompileHangError(RuntimeError):
+    """A materialization stage exceeded the ``TDX_COMPILE_DEADLINE_S``
+    watchdog deadline; its worker thread was abandoned (a wedged XLA
+    compile cannot be cancelled from Python).  Always retryable."""
 
 # Init programs execute once for milliseconds; optimized codegen buys
 # nothing while costing ~2x compile wall time on TPU.  Ask XLA for its
@@ -128,6 +180,7 @@ def _maybe_enable_cache() -> None:
 
         cache_dir = config.get().cache_dir
         if cache_dir:
+            _install_cache_guard()
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             # TDX_CACHE_MIN_COMPILE_S=0 persists even trivial programs —
             # tests use it to exercise the compile-cache hit/miss telemetry
@@ -167,6 +220,230 @@ def _reset_cache_binding() -> None:
             _cc.reset_cache()
         except Exception:
             pass
+
+
+# -- corrupt-cache quarantine ------------------------------------------------
+#
+# jax loads a persistent-cache entry by decompressing + deserializing the
+# on-disk bytes; a truncated or bit-rotted entry raises there, and —
+# depending on jax's raise_persistent_cache_errors config — either aborts
+# the compile outright or silently degrades to a warning-and-recompile
+# that leaves the poisoned entry on disk for every later process to trip
+# over again.  The guard wraps the loader ONCE: a failing entry is
+# QUARANTINED (renamed `<entry>.corrupt`, kept for forensics like
+# checkpoint quarantine), counted in tdx.jax.cache_quarantined, and
+# reported as a miss so the ladder recompiles and re-persists a clean
+# entry in its place.
+
+_cache_guard_state: Optional[bool] = None  # None = not yet attempted
+_cache_guard_lock = threading.Lock()
+
+
+def _quarantine_cache_entry(cache_key: str) -> List[str]:
+    """Rename the on-disk entry file(s) for ``cache_key`` to
+    ``<name>.corrupt``; returns the names moved (empty when no cache dir
+    is bound or the entry has already vanished)."""
+    d = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not d:
+        return []
+    moved: List[str] = []
+    try:
+        for name in os.listdir(d):
+            # LRUCache stores `<key>-cache` (+ an atime stamp the LRU
+            # bookkeeping owns); other CacheInterface impls store the
+            # bare key.  Never re-quarantine an already-moved entry.
+            if name in (f"{cache_key}-cache", cache_key):
+                os.replace(
+                    os.path.join(d, name), os.path.join(d, name + ".corrupt")
+                )
+                moved.append(name)
+    except OSError:
+        pass
+    return moved
+
+
+def _install_cache_guard() -> bool:
+    """Wrap ``jax._src.compilation_cache.get_executable_and_time`` with
+    the quarantine-on-corrupt behavior; installed once per process, a
+    no-op when jax's internals moved (False)."""
+    global _cache_guard_state
+    with _cache_guard_lock:
+        if _cache_guard_state is not None:
+            return _cache_guard_state
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _orig = _cc.get_executable_and_time
+
+            def _guarded(cache_key, compile_options, backend):
+                try:
+                    return _orig(cache_key, compile_options, backend)
+                except Exception as e:  # noqa: BLE001 — any load error
+                    moved = _quarantine_cache_entry(cache_key)
+                    observe.counter("tdx.jax.cache_quarantined").inc(
+                        max(1, len(moved))
+                    )
+                    observe.instant(
+                        "jax.cache_quarantined", category="jax",
+                        key=cache_key, error=f"{type(e).__name__}: {e}"[:200],
+                        moved=len(moved),
+                    )
+                    get_logger().warning(
+                        "materialize: corrupt persistent-cache entry %s "
+                        "(%s: %s); quarantined %s and recompiling",
+                        cache_key, type(e).__name__, str(e)[:120],
+                        [m + ".corrupt" for m in moved] or "(file gone)",
+                    )
+                    return None, None  # a miss: the caller recompiles
+
+            _cc.get_executable_and_time = _guarded
+            _cache_guard_state = True
+        except Exception:  # pragma: no cover — jax internals moved
+            _cache_guard_state = False
+        return _cache_guard_state
+
+
+# -- self-healing ladder ------------------------------------------------------
+
+_RETRY_BACKOFF_BASE_S = 0.05
+_RETRY_BACKOFF_MAX_S = 2.0
+_retryable_cache: Optional[tuple] = None
+
+
+def _retryable_errors() -> tuple:
+    """Exception types the materialization ladder retries: the jax/XLA
+    runtime error shapes (what device loss and transient compiler
+    failures surface as), the chaos fallback error, and the watchdog's
+    :class:`CompileHangError`.  Everything else — ``NotImplementedError``
+    from an unsupported op, ``ValueError`` from bad config — is a real
+    bug and fails fast."""
+    global _retryable_cache
+    if _retryable_cache is None:
+        errs: list = [CompileHangError, chaos.InjectedRuntimeError]
+        try:
+            errs.append(jax.errors.JaxRuntimeError)
+        except AttributeError:
+            pass
+        try:
+            from jax._src.lib import xla_client
+
+            errs.append(xla_client.XlaRuntimeError)
+        except Exception:
+            pass
+        _retryable_cache = tuple(errs)
+    return _retryable_cache
+
+
+def _retry_backoff(attempt: int) -> None:
+    time.sleep(min(_RETRY_BACKOFF_MAX_S,
+                   _RETRY_BACKOFF_BASE_S * (2 ** (attempt - 1))))
+
+
+def _run_ladder(attempt_fn, *, retries: int, retryable: tuple,
+                describe: str, bypass_note: bool = False):
+    """THE retry ladder every materialization stage runs: call
+    ``attempt_fn(attempt)`` until it returns, retrying only ``retryable``
+    errors up to ``retries`` times with exponential backoff, counting
+    each retry in ``tdx.jax.compile_retries``.  ``attempt_fn`` receives
+    the 0-based attempt number — rungs that vary by attempt (the final
+    retry's cache bypass) key off it.  The final error re-raises
+    unchanged: callers choose the terminal action (wrap in
+    :class:`MaterializationError`, fail the group, fall back)."""
+    attempt = 0
+    while True:
+        try:
+            return attempt_fn(attempt)
+        except Exception as e:  # noqa: BLE001 — classified just below
+            if not isinstance(e, retryable):
+                raise
+            attempt += 1
+            if attempt > retries:
+                raise
+            observe.counter("tdx.jax.compile_retries").inc()
+            get_logger().warning(
+                "materialize: %s failed (%s: %s); retry %d/%d%s",
+                describe, type(e).__name__, str(e)[:120], attempt, retries,
+                " with persistent cache bypassed"
+                if bypass_note and attempt == retries else "",
+            )
+            _retry_backoff(attempt)
+
+
+def _chaos_cache_path() -> Optional[str]:
+    """The bound persistent-cache dir, the target of cache-corruption
+    faults at the materialization sites."""
+    return getattr(jax.config, "jax_compilation_cache_dir", None)
+
+
+def _bounded_stage(stage: str, fn, *, deadline: Optional[float], group: int):
+    """Run one materialization stage, optionally under the compile
+    watchdog: with a deadline the stage runs on a daemon thread that is
+    ABANDONED on timeout (the device_health abandoned-thread recipe — a
+    wedged XLA compile cannot be cancelled from Python) and the stage is
+    reported retryable via :class:`CompileHangError`.  Injected chaos
+    hangs on the abandoned thread wake on the cancel event instead of
+    sleeping out their full argument."""
+    if not deadline or deadline <= 0:
+        return fn()
+    box: Dict[str, object] = {}
+    cancel = threading.Event()
+
+    def _target():
+        chaos.set_cancel_event(cancel)
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["error"] = e
+
+    t = threading.Thread(
+        target=_target, daemon=True, name=f"tdx-mat-{stage}-{group}"
+    )
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        cancel.set()
+        observe.counter("tdx.jax.compile_watchdog_kills").inc()
+        observe.instant(
+            "jax.compile_watchdog_kill", category="jax",
+            stage=stage, group=group, deadline_s=deadline,
+        )
+        raise CompileHangError(
+            f"init-program {stage} of group {group} exceeded the "
+            f"{deadline}s watchdog deadline (TDX_COMPILE_DEADLINE_S); "
+            f"worker thread abandoned — the stage will be retried"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+_bypass_lock = threading.Lock()
+
+
+class _cache_bypass:
+    """Temporarily unbind the persistent compile cache — the ladder's
+    fresh-compile rung: the final retry of a repeatedly failing program
+    must not be able to fail through a poisoned cache entry the
+    quarantine guard could not catch.  Serialized under a lock; a
+    concurrent compile during the window merely skips the cache (slower,
+    never wrong)."""
+
+    def __enter__(self):
+        _bypass_lock.acquire()
+        self._prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            jax.config.update("jax_compilation_cache_dir", self._prev)
+        except Exception:
+            pass
+        _bypass_lock.release()
+        return False
 
 
 # -- compile-cache outcome accounting ---------------------------------------
@@ -274,11 +551,21 @@ def _set_run_stats(**kw) -> None:
         _last_run_stats.update(kw)
 
 
-def _compile_program(init_fn, key, out_shardings, label=None):
+def _compile_program(init_fn, key, out_shardings, label=None, *,
+                     fault_plan=None, deadline=None, bypass_cache=False):
     """jit → lower → compile ONE init program; returns
     ``(compiled, lower_s, compile_s, cache_outcome)``.  Safe to call from
     several threads at once — jax tracing is thread-local and the cache
-    outcome is attributed through this thread's monitoring record."""
+    outcome is attributed through the monitoring record of whichever
+    thread runs the compile (the watchdog may move it to an inner
+    thread, so the record is installed there, not on the caller).
+
+    ``fault_plan`` pins the chaos plan for the ``lower`` / ``cache`` /
+    ``compile`` injection sites (group-number keyed; the monolith is
+    group 1); ``deadline`` arms the stage watchdog; ``bypass_cache``
+    compiles with the persistent cache unbound — the ladder's
+    fresh-compile rung."""
+    gno = label + 1 if isinstance(label, int) else 1
     if out_shardings is not None:
         jitted = jax.jit(init_fn, out_shardings=out_shardings)
     else:
@@ -287,64 +574,272 @@ def _compile_program(init_fn, key, out_shardings, label=None):
     attrs = {} if label is None else {"group": label}
     t0 = time.perf_counter()
     with observe.span("jax.lower", category="jax", **attrs):
-        lowered = jitted.lower(key)
+        def _do_lower():
+            chaos.maybe_inject(
+                "lower", gno, path=_chaos_cache_path(), plan=fault_plan
+            )
+            return jitted.lower(key)
+
+        lowered = _bounded_stage("lower", _do_lower, deadline=deadline,
+                                 group=gno)
     t_lower = time.perf_counter() - t0
     exact = _install_cache_listener()
+    # Captured OUTSIDE the compile closure: during the ladder's bypass
+    # rung the cache dir is temporarily unbound, and a cache-corruption
+    # fault still pending on the final retry must target the REAL
+    # configured dir, not fail on path=None.
+    cdir = _chaos_cache_path()
     t0 = time.perf_counter()
     with observe.span("jax.compile", category="jax", **attrs) as csp:
         events: List[str] = []
         before = None if exact else _persistent_cache_entries()
-        if exact:
-            _mon_tls.events = events
-        try:
-            compiled = (
-                lowered.compile(compiler_options=opts)
-                if opts is not None else lowered.compile()
-            )
-        finally:
+
+        def _do_compile():
             if exact:
-                _mon_tls.events = None
-        if not getattr(jax.config, "jax_compilation_cache_dir", None):
-            outcome = "uncached"  # no persistent cache dir configured
-        elif exact:
-            outcome = "hit" if _HIT_EVENT in events else "miss"
+                _mon_tls.events = events
+            try:
+                chaos.maybe_inject("cache", gno, path=cdir, plan=fault_plan)
+                chaos.maybe_inject("compile", gno, path=cdir, plan=fault_plan)
+                return (
+                    lowered.compile(compiler_options=opts)
+                    if opts is not None else lowered.compile()
+                )
+            finally:
+                if exact:
+                    _mon_tls.events = None
+
+        if bypass_cache:
+            with _cache_bypass():
+                compiled = _bounded_stage(
+                    "compile", _do_compile, deadline=deadline, group=gno
+                )
+            outcome = "bypass"
         else:
-            # Monitoring-less jax: the legacy directory differencing
-            # (exact serially; approximate if compiles run concurrently).
-            after = _persistent_cache_entries()
-            outcome = "miss" if (after != before or not before) else "hit"
+            compiled = _bounded_stage(
+                "compile", _do_compile, deadline=deadline, group=gno
+            )
+            if not getattr(jax.config, "jax_compilation_cache_dir", None):
+                outcome = "uncached"  # no persistent cache dir configured
+            elif exact:
+                outcome = "hit" if _HIT_EVENT in events else "miss"
+            else:
+                # Monitoring-less jax: the legacy directory differencing
+                # (exact serially; approximate if compiles run concurrently).
+                after = _persistent_cache_entries()
+                outcome = "miss" if (after != before or not before) else "hit"
         csp.set(cache=outcome)
         if observe.enabled():
             observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
     return compiled, t_lower, time.perf_counter() - t0, outcome
 
 
-def _run_init(init_fn, key, out_shardings=None):
-    """Monolithic engine: one program, lower → compile → execute.
+def _execute_compiled(compiled, key, gno, *, deadline, fault_plan,
+                      retries, retryable):
+    """Dispatch one compiled program with the ``execute`` chaos site,
+    the stage watchdog, and a bounded re-dispatch ladder (an executable
+    in hand re-executes cheaply; a transient dispatch failure must not
+    burn a whole recompile)."""
+
+    def _attempt(_a):
+        def _do_execute():
+            chaos.maybe_inject(
+                "execute", gno, path=_chaos_cache_path(), plan=fault_plan
+            )
+            return compiled(key)
+
+        return _bounded_stage("execute", _do_execute, deadline=deadline,
+                              group=gno)
+
+    return _run_ladder(_attempt, retries=retries, retryable=retryable,
+                       describe=f"execute of group {gno}")
+
+
+def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None):
+    """Monolithic engine: one program, lower → compile → execute, each
+    stage under the self-healing ladder (bounded retries with backoff;
+    the final retry bypasses the persistent cache; a deadline-armed
+    watchdog abandons a wedged stage).  Exhaustion raises
+    :class:`MaterializationError`.
 
     Returns with the values RESIDENT (block_until_ready) — both engines
     share that contract so "materialized" means landed, the execute span
     and ``last_run_stats`` report true device time, and the pipelined
     overlap accounting stays honest.  Init is a once-per-process path;
     async-dispatch overlap with later host code bought nothing real."""
+    from .. import config
+
     _maybe_enable_cache()
+    cfg = config.get()
+    retries = max(0, cfg.materialize_retries)
+    deadline = cfg.compile_deadline_s or None
+    retryable = _retryable_errors()
     t_wall = time.perf_counter()
-    compiled, t_lower, t_compile, outcome = _compile_program(
-        init_fn, key, out_shardings
-    )
-    t0 = time.perf_counter()
-    with observe.span("jax.execute", category="jax") as esp:
-        out = compiled(key)
-        esp.block_on(out)
-    jax.block_until_ready(out)
-    t_exec = time.perf_counter() - t0
+
+    def _attempt(a):
+        compiled, t_lower, t_compile, outcome = _compile_program(
+            init_fn, key, out_shardings, fault_plan=fault_plan,
+            deadline=deadline,
+            bypass_cache=(retries > 0 and a == retries),
+        )
+        t0 = time.perf_counter()
+        with observe.span("jax.execute", category="jax") as esp:
+            # The execute stage runs its own per-STAGE ladder, exactly
+            # like the pipelined engine's dispatcher; exhausting it is
+            # TERMINAL (wrapped non-retryable below) — re-entering the
+            # outer compile ladder would recompile an executable that
+            # was never the problem and square the documented budget.
+            try:
+                out = _execute_compiled(
+                    compiled, key, 1, deadline=deadline,
+                    fault_plan=fault_plan, retries=retries,
+                    retryable=retryable,
+                )
+            except Exception as e:  # noqa: BLE001 — classified below
+                if isinstance(e, retryable):
+                    raise MaterializationError(
+                        f"monolithic execute failed after {retries} "
+                        f"retries: {type(e).__name__}: {e}",
+                        failed_groups=[0],
+                    ) from e
+                raise
+            esp.block_on(out)
+        jax.block_until_ready(out)
+        return out, t_lower, t_compile, time.perf_counter() - t0, outcome, a
+
+    try:
+        out, t_lower, t_compile, t_exec, outcome, attempts = _run_ladder(
+            _attempt, retries=retries, retryable=retryable,
+            describe="monolithic program", bypass_note=True,
+        )
+    except Exception as e:  # noqa: BLE001 — classified just below
+        if not isinstance(e, retryable):
+            raise
+        raise MaterializationError(
+            f"monolithic init program failed after {retries} "
+            f"retries: {type(e).__name__}: {e}",
+            failed_groups=[0],
+        ) from e
     _set_run_stats(
         mode="monolithic", n_programs=1, workers=1,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
         wall_s=time.perf_counter() - t_wall,
-        overlap=1.0, cache={outcome: 1},
+        overlap=1.0, cache={outcome: 1}, retries=attempts,
     )
     return out
+
+
+# -- partial-progress resume -------------------------------------------------
+#
+# With TDX_MATERIALIZE_RESUME_DIR set, the pipelined engine commits each
+# completed group's outputs (raw bytes + CRC32) under the resume dir,
+# keyed by a cross-process-stable content fingerprint of the group's
+# recorded computation (compile.group_fingerprint + seed / dtype policy /
+# sharding).  A rerun after an interrupted materialization loads the
+# committed groups from disk instead of re-lowering/compiling/executing
+# them; a fully successful materialization clears its progress state.
+# Manifest writes are atomic (tmp + rename) and happen only on the
+# dispatcher thread.
+
+_RESUME_MANIFEST = "MATERIALIZE_PROGRESS.json"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bf16 etc. when numpy alone can't resolve it
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _load_resume_manifest(rdir: str) -> Dict[str, dict]:
+    try:
+        with open(os.path.join(rdir, _RESUME_MANIFEST)) as f:
+            m = json.load(f)
+        if m.get("version") == 1 and isinstance(m.get("groups"), dict):
+            return m["groups"]
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _write_resume_manifest(rdir: str, groups: Dict[str, dict]) -> None:
+    path = os.path.join(rdir, _RESUME_MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "groups": groups, "pid": os.getpid(),
+                   "time": time.time()}, f)
+    os.replace(tmp, path)
+
+
+def _commit_resume_group(rdir: str, groups: Dict[str, dict], fp: str,
+                         idxs: List[int], values: List) -> None:
+    """Persist one completed group: outputs first (raw bytes + CRC32),
+    then the manifest entry — manifest ⇒ payload, same commit-order
+    discipline as checkpoints."""
+    gdir = os.path.join(rdir, fp)
+    os.makedirs(gdir, exist_ok=True)
+    outs = []
+    for j, v in enumerate(values):
+        arr = np.asarray(v)
+        data = arr.tobytes()
+        rel = f"out_{j:04d}.bin"
+        with open(os.path.join(gdir, rel), "wb") as f:
+            f.write(data)
+        outs.append({"file": rel, "shape": list(arr.shape),
+                     "dtype": str(arr.dtype), "crc32": zlib.crc32(data)})
+    groups[fp] = {"indices": list(idxs), "outputs": outs}
+    _write_resume_manifest(rdir, groups)
+
+
+def _try_resume_group(rdir: str, fp: str, rec: dict, idxs: List[int],
+                      out_shardings) -> Optional[List]:
+    """Load one committed group's outputs back onto the devices with
+    their planned shardings; None (recompute) on ANY mismatch — wrong
+    indices, missing file, CRC failure, bad shape."""
+    if rec.get("indices") != list(idxs):
+        return None
+    if len(rec.get("outputs") or ()) != len(idxs):
+        return None  # truncated manifest entry: a hole, not a resume
+    vals: List = []
+    try:
+        for i, o in zip(idxs, rec["outputs"]):
+            with open(os.path.join(rdir, fp, o["file"]), "rb") as f:
+                data = f.read()
+            if zlib.crc32(data) != o["crc32"]:
+                return None
+            arr = np.frombuffer(data, dtype=_np_dtype(o["dtype"]))
+            arr = arr.reshape(o["shape"])
+            if out_shardings is not None:
+                vals.append(jax.device_put(arr, out_shardings[i]))
+            else:
+                vals.append(jax.numpy.asarray(arr))
+    except Exception:  # noqa: BLE001 — any load/reshard failure: recompute
+        return None
+    return vals
+
+
+def _clear_resume_state(rdir: str) -> None:
+    """A materialization completed: its progress manifest and committed
+    group payloads are spent — remove them so stale outputs can never be
+    resumed into a later, different materialization.  Every
+    fingerprint-named payload dir is swept, not only manifest-listed
+    ones: a dir orphaned by a CRC-failed entry (popped from the
+    manifest) or a crash between payload and manifest writes would
+    otherwise leak parameter-sized bytes forever."""
+    try:
+        names = os.listdir(rdir)
+    except OSError:
+        return
+    for name in names:
+        p = os.path.join(rdir, name)
+        if (len(name) == 40 and all(c in "0123456789abcdef" for c in name)
+                and os.path.isdir(p)):
+            shutil.rmtree(p, ignore_errors=True)
+    try:
+        os.remove(os.path.join(rdir, _RESUME_MANIFEST))
+    except OSError:
+        pass
 
 
 def _pipeline_workers() -> int:
@@ -395,8 +890,29 @@ def _plan_pipeline(fake_list) -> Optional[List[List[int]]]:
     return bins if len(bins) >= 2 else None
 
 
+def _group_fp(fake_list, idxs, out_shardings, param_dtype, cast_mask,
+              seed) -> Optional[str]:
+    """Resume-manifest key for one group: the content fingerprint of its
+    recorded computation composed with everything else the output values
+    depend on (seed, cast policy, planned shardings).  None when a
+    stable fingerprint cannot be built (the group is then simply never
+    resumed)."""
+    import hashlib
+
+    try:
+        structural = group_fingerprint([fake_list[i] for i in idxs])
+    except Exception:  # noqa: BLE001 — unstable chain: recompute, never skip
+        return None
+    h = hashlib.sha1(structural.encode())
+    for i in idxs:
+        osh = out_shardings[i] if out_shardings is not None else None
+        h.update(repr((i, seed, str(param_dtype), bool(cast_mask[i]),
+                       str(osh))).encode())
+    return h.hexdigest()
+
+
 def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
-                        cast_mask):
+                        cast_mask, *, seed=0, fault_plan=None):
     """Pipelined engine: concurrent per-group build/lower/compile on a
     worker pool, execution dispatched as each executable lands.
 
@@ -405,9 +921,22 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
     run truly concurrently on multi-core hosts; and the dispatcher's
     execute of finished groups (async device work) overlaps the remaining
     compiles.  Outputs stream straight into their planned NamedShardings
-    — there is no gather or reorder step, each slot is written once."""
+    — there is no gather or reorder step, each slot is written once.
+
+    Fault tolerance (docs/robustness.md): each group runs the bounded
+    retry ladder (backoff; final retry bypasses the persistent cache)
+    with the optional stage watchdog; a group that exhausts its ladder
+    marks the run failed, and after the surviving groups land the engine
+    raises :class:`MaterializationError` — the caller degrades to the
+    monolithic program.  With ``TDX_MATERIALIZE_RESUME_DIR`` set,
+    completed groups are committed to a progress manifest as they land
+    (fingerprint-keyed; forced resident first), already-committed groups
+    from an interrupted run are loaded from disk instead of recompiled,
+    and a SIGTERM drains: stop dispatching, commit what finished, raise
+    ``MaterializationError(drained=True)``."""
     from .. import config
 
+    log = get_logger()
     _maybe_enable_cache()
     workers = _pipeline_workers()
     results: List = [None] * len(fake_list)
@@ -418,6 +947,52 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
     # activation and — worse — tracing-time knobs like rng_chunk_elems,
     # whose divergence between engines would break bitwise parity.
     eff_cfg = config.get()
+    retries = max(0, eff_cfg.materialize_retries)
+    deadline = eff_cfg.compile_deadline_s or None
+    retryable = _retryable_errors()
+    rdir = eff_cfg.materialize_resume_dir
+
+    manifest: Dict[str, dict] = {}
+    fps: List[Optional[str]] = [None] * len(bins)
+    resumed: set = set()
+    if rdir:
+        os.makedirs(rdir, exist_ok=True)
+        manifest = _load_resume_manifest(rdir)
+        for gi, idxs in enumerate(bins):
+            fps[gi] = _group_fp(fake_list, idxs, out_shardings, param_dtype,
+                                cast_mask, seed)
+            rec = manifest.get(fps[gi]) if fps[gi] else None
+            if rec is None:
+                continue
+            vals = _try_resume_group(rdir, fps[gi], rec, idxs, out_shardings)
+            if vals is None:
+                manifest.pop(fps[gi], None)  # stale/corrupt: recompute
+                continue
+            for i, v in zip(idxs, vals):
+                results[i] = v
+            resumed.add(gi)
+        if resumed:
+            observe.counter("tdx.jax.groups_resumed").inc(len(resumed))
+            outcomes["resumed"] = len(resumed)
+            log.info(
+                "materialize: resumed %d/%d committed group(s) from %s",
+                len(resumed), len(bins), rdir,
+            )
+
+    # SIGTERM drain (announced preemption): stop dispatching, keep the
+    # committed progress, raise a resumable MaterializationError.  Only
+    # armed when there is a manifest to leave and we own the main
+    # thread's signal handling.
+    drain = {"requested": False}
+    drain_handled = False
+    prev_handler = None
+    handler_installed = False
+    if rdir and threading.current_thread() is threading.main_thread():
+        def _on_sigterm(signum, frame):  # noqa: ARG001 — signal signature
+            drain["requested"] = True
+
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        handler_installed = True
 
     def build_and_compile(gi: int, idxs: List[int]):
         sub = [fake_list[i] for i in idxs]
@@ -425,59 +1000,205 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
             "jax.pipeline.group", category="jax", group=gi,
             n_outputs=len(sub),
         ):
-            fn = build_init_fn(sub)
-            if param_dtype is not None:
-                fn = _cast_outputs(
-                    fn, param_dtype, [cast_mask[i] for i in idxs]
+            def _attempt(a):
+                fn = build_init_fn(sub)
+                if param_dtype is not None:
+                    fn = _cast_outputs(
+                        fn, param_dtype, [cast_mask[i] for i in idxs]
+                    )
+                osh = (
+                    tuple(out_shardings[i] for i in idxs)
+                    if out_shardings is not None else None
                 )
-            osh = (
-                tuple(out_shardings[i] for i in idxs)
-                if out_shardings is not None else None
+                return _compile_program(
+                    fn, key, osh, label=gi, fault_plan=fault_plan,
+                    deadline=deadline,
+                    bypass_cache=(retries > 0 and a == retries),
+                )
+
+            return _run_ladder(
+                _attempt, retries=retries, retryable=retryable,
+                describe=f"group {gi} compile", bypass_note=True,
             )
-            return _compile_program(fn, key, osh, label=gi)
 
     t_wall = time.perf_counter()
     t_lower = t_compile = t_exec = 0.0
-    with observe.span(
-        "jax.pipeline", category="jax", n_programs=len(bins), workers=workers
-    ) as psp:
-        pool = ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="tdx-compile"
-        )
-        try:
-            futs = {
-                pool.submit(build_and_compile, gi, idxs): (gi, idxs)
-                for gi, idxs in enumerate(bins)
-            }
-            for fut in as_completed(futs):
-                gi, idxs = futs[fut]
-                compiled, tl, tc, outcome = fut.result()
-                t_lower += tl
-                t_compile += tc
-                outcomes[outcome] = outcomes.get(outcome, 0) + 1
-                t0 = time.perf_counter()
-                with observe.span("jax.execute", category="jax", group=gi):
-                    outs = compiled(key)  # async dispatch; lands sharded
-                t_exec += time.perf_counter() - t0
-                for i, v in zip(idxs, outs):
-                    results[i] = v
-        except BaseException:
-            pool.shutdown(wait=True, cancel_futures=True)
-            raise
-        pool.shutdown(wait=True)
-        # The dispatch loop above never blocked: execute_s is dispatch
-        # plus this residual device wait — the execution time NOT hidden
-        # behind compilation (per-program device busy time is not
-        # observable without serializing on per-group blocks).
-        t0 = time.perf_counter()
-        jax.block_until_ready(results)
-        t_exec += time.perf_counter() - t0
-        wall = time.perf_counter() - t_wall
-        busy = t_lower + t_compile + t_exec
-        overlap = busy / wall if wall > 0 else 1.0
-        psp.set(overlap=round(overlap, 3), cache=dict(outcomes))
-        if observe.enabled():
-            observe.gauge("tdx.jax.pipeline_overlap").set(round(overlap, 3))
+    failed: Dict[int, BaseException] = {}
+    completed: set = set(resumed)
+    try:
+        with observe.span(
+            "jax.pipeline", category="jax", n_programs=len(bins),
+            workers=workers,
+        ) as psp:
+            pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tdx-compile"
+            )
+            try:
+                futs = {
+                    pool.submit(build_and_compile, gi, bins[gi]): gi
+                    for gi in range(len(bins)) if gi not in resumed
+                }
+                pending = set(futs)
+                while pending and not drain["requested"]:
+                    # A short wait timeout (handler armed only) keeps the
+                    # dispatcher responsive to a SIGTERM that arrives
+                    # while every worker is deep in a long compile.
+                    done, pending = _futures_wait(
+                        pending,
+                        timeout=0.25 if handler_installed else None,
+                        return_when=FIRST_COMPLETED,
+                    )
+                    for fut in done:
+                        if drain["requested"]:
+                            break
+                        gi = futs[fut]
+                        idxs = bins[gi]
+                        try:
+                            compiled, tl, tc, outcome = fut.result()
+                        except Exception as e:  # noqa: BLE001
+                            if not isinstance(e, retryable):
+                                raise
+                            failed[gi] = e
+                            log.error(
+                                "materialize: group %d exhausted its retry "
+                                "ladder (%s: %s)", gi, type(e).__name__,
+                                str(e)[:160],
+                            )
+                            continue
+                        t_lower += tl
+                        t_compile += tc
+                        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+                        t0 = time.perf_counter()
+                        try:
+                            with observe.span(
+                                "jax.execute", category="jax", group=gi
+                            ):
+                                # async dispatch; lands sharded
+                                outs = _execute_compiled(
+                                    compiled, key, gi + 1,
+                                    deadline=deadline, fault_plan=fault_plan,
+                                    retries=retries, retryable=retryable,
+                                )
+                        except Exception as e:  # noqa: BLE001
+                            t_exec += time.perf_counter() - t0
+                            if not isinstance(e, retryable):
+                                raise
+                            failed[gi] = e
+                            log.error(
+                                "materialize: group %d execute exhausted its "
+                                "retry ladder (%s: %s)", gi,
+                                type(e).__name__, str(e)[:160],
+                            )
+                            continue
+                        t_exec += time.perf_counter() - t0
+                        for i, v in zip(idxs, outs):
+                            results[i] = v
+                        completed.add(gi)
+                        if rdir and fps[gi]:
+                            # Progress commit forces residency (the bytes
+                            # are read back); documented cost of arming
+                            # resume — off by default.  An ASYNC execution
+                            # error surfaces at this block: classify it
+                            # like any execute failure, not a crash.
+                            try:
+                                jax.block_until_ready(
+                                    [results[i] for i in idxs]
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                if not isinstance(e, retryable):
+                                    raise
+                                completed.discard(gi)
+                                failed[gi] = e
+                                log.error(
+                                    "materialize: group %d failed "
+                                    "asynchronously (%s: %s)", gi,
+                                    type(e).__name__, str(e)[:160],
+                                )
+                                continue
+                            try:
+                                _commit_resume_group(
+                                    rdir, manifest, fps[gi], idxs,
+                                    [results[i] for i in idxs],
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                # The commit is an OPTIONAL amenity: a
+                                # full disk, or np.asarray refusing a
+                                # non-fully-addressable sharded output
+                                # (multi-host), must cost the resume
+                                # entry, never the materialization.
+                                log.warning(
+                                    "materialize: progress commit of group "
+                                    "%d failed (%s: %s); resume will "
+                                    "recompute it", gi, type(e).__name__, e,
+                                )
+            except BaseException:
+                pool.shutdown(wait=True, cancel_futures=True)
+                raise
+            pool.shutdown(wait=True, cancel_futures=drain["requested"])
+
+            if drain["requested"]:
+                drain_handled = True
+                raise MaterializationError(
+                    f"materialization drained on SIGTERM with "
+                    f"{len(completed)}/{len(bins)} groups committed",
+                    completed_groups=completed,
+                    failed_groups=set(range(len(bins))) - completed,
+                    resumable=bool(rdir), drained=True,
+                )
+            if failed:
+                raise MaterializationError(
+                    f"{len(failed)} of {len(bins)} init program groups "
+                    f"failed after retries: " + "; ".join(
+                        f"group {gi}: {type(e).__name__}: {str(e)[:80]}"
+                        for gi, e in sorted(failed.items())
+                    ),
+                    completed_groups=completed, failed_groups=set(failed),
+                    resumable=bool(rdir),
+                )
+
+            # The dispatch loop above never blocked: execute_s is dispatch
+            # plus this residual device wait — the execution time NOT
+            # hidden behind compilation (per-program device busy time is
+            # not observable without serializing on per-group blocks).
+            # A device-side failure of any async dispatch also surfaces
+            # HERE; it must enter the ladder (→ monolithic fallback) as a
+            # typed error, not escape raw — which group failed is not
+            # attributable at the barrier, so no committed value is
+            # trusted.
+            t0 = time.perf_counter()
+            try:
+                jax.block_until_ready(results)
+            except Exception as e:  # noqa: BLE001 — classified just below
+                if not isinstance(e, retryable):
+                    raise
+                raise MaterializationError(
+                    f"asynchronous execution failure after dispatch: "
+                    f"{type(e).__name__}: {e}",
+                    completed_groups=(),
+                    failed_groups=set(range(len(bins))),
+                ) from e
+            t_exec += time.perf_counter() - t0
+            wall = time.perf_counter() - t_wall
+            busy = t_lower + t_compile + t_exec
+            overlap = busy / wall if wall > 0 else 1.0
+            psp.set(overlap=round(overlap, 3), cache=dict(outcomes))
+            if observe.enabled():
+                observe.gauge("tdx.jax.pipeline_overlap").set(
+                    round(overlap, 3)
+                )
+    finally:
+        if handler_installed:
+            signal.signal(signal.SIGTERM, prev_handler)
+            if drain["requested"] and not drain_handled:
+                # The notice landed after the last drain check (final
+                # device wait, bookkeeping): the materialization is done,
+                # but the preemption must not be SWALLOWED — re-deliver
+                # it to the just-restored handler (the enclosing
+                # application's, e.g. run_elastic's drain, or the
+                # default action).
+                os.kill(os.getpid(), signal.SIGTERM)
+    if rdir:
+        _clear_resume_state(rdir)  # success: the progress is spent
     _set_run_stats(
         mode="pipelined", n_programs=len(bins), workers=workers,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
@@ -490,7 +1211,11 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
                         cast_mask):
     """The ONE instrumented materialization core both public entry points
     share: engine selection (monolithic vs pipelined), the
-    ``jax.materialize`` span, and bytes / GB/s accounting."""
+    ``jax.materialize`` span, bytes / GB/s accounting, and the last rung
+    of the degradation ladder — a pipelined run whose groups exhausted
+    their retries falls back to the monolithic off-mode program (bitwise
+    identical by construction) before a typed
+    :class:`MaterializationError` is allowed to escape."""
     from .. import config
 
     t0 = time.perf_counter()
@@ -503,17 +1228,52 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
             raise ValueError(
                 f"TDX_MATERIALIZE_PIPELINE={mode!r}: expected 'off' or 'auto'"
             )
+        # Pinned ONCE on the caller's thread: a thread-local
+        # tdx_config.override(fault_plan=...) scope must bind even though
+        # the lower/compile sites fire on pool worker threads.
+        fault_plan = chaos.active_plan()
         bins = _plan_pipeline(fake_list) if mode == "auto" else None
         key = jax.random.PRNGKey(seed)
         if bins is None:
             init_fn = _cast_outputs(
                 build_init_fn(fake_list), param_dtype, cast_mask
             )
-            values = _run_init(init_fn, key, out_shardings)
+            values = _run_init(init_fn, key, out_shardings,
+                               fault_plan=fault_plan)
         else:
-            values = _run_init_pipelined(
-                fake_list, bins, key, out_shardings, param_dtype, cast_mask
-            )
+            try:
+                values = _run_init_pipelined(
+                    fake_list, bins, key, out_shardings, param_dtype,
+                    cast_mask, seed=seed, fault_plan=fault_plan,
+                )
+            except MaterializationError as e:
+                if e.drained:
+                    raise  # preemption: no fallback, the progress is saved
+                observe.counter("tdx.jax.pipeline_fallbacks").inc()
+                observe.instant(
+                    "jax.pipeline_fallback", category="jax",
+                    failed_groups=list(e.failed_groups),
+                )
+                get_logger().error(
+                    "materialize: pipelined engine failed (%s); falling "
+                    "back to the monolithic program", e,
+                )
+                init_fn = _cast_outputs(
+                    build_init_fn(fake_list), param_dtype, cast_mask
+                )
+                try:
+                    values = _run_init(init_fn, key, out_shardings,
+                                       fault_plan=fault_plan)
+                except MaterializationError as e2:
+                    # The whole ladder is spent; surface the pipelined
+                    # run's partial progress so a rerun can resume it.
+                    e2.completed_groups = e.completed_groups
+                    e2.failed_groups = e.failed_groups
+                    e2.resumable = e.resumable
+                    raise
+                rdir = config.get().materialize_resume_dir
+                if rdir:
+                    _clear_resume_state(rdir)  # monolith delivered it all
         if observe.enabled():
             # Both engines block before returning, so this is a
             # bookkeeping pass, not a second sync.

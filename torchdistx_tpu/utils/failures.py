@@ -37,7 +37,8 @@ failure mode it claims to survive is injectable via
 
 Telemetry (PR 2 vocabulary, docs/robustness.md): counters
 ``tdx.elastic.restarts`` / ``.watchdog_kills`` / ``.drains`` /
-``.unhealthy_restarts``, ``tdx.ckpt.verify_fail`` / ``.quarantined``,
+``.drain_failures`` / ``.unhealthy_restarts``,
+``tdx.ckpt.verify_fail`` / ``.quarantined``,
 ``tdx.chaos.injected{kind=...}``; spans ``ckpt.save`` / ``ckpt.restore``
 / ``ckpt.verify``.
 """
@@ -85,8 +86,21 @@ CLEAN_EXIT_MARKER = "CLEAN_EXIT.json"
 
 # device id -> abandoned probe thread (see device_health): while one is
 # still wedged, re-probes of that device are refused instead of stacking
-# another doomed thread per poll.
+# another doomed thread per poll.  Lock-guarded: device_health is
+# documented for concurrent FailureDetector use (sidecar thread + the
+# between-steps check probing at once).  _PROBE_LOCKS serializes the
+# whole check→probe→register sequence PER DEVICE — without it two
+# concurrent callers both pass the stuck-check before either times out
+# and each leaks an abandoned thread, breaking the one-thread-per-wedged-
+# device invariant the dict exists to enforce.
 _STUCK_PROBES: Dict[int, threading.Thread] = {}
+_PROBE_LOCKS: Dict[int, threading.Lock] = {}
+_stuck_probes_lock = threading.Lock()
+
+
+def _probe_lock(device_id: int) -> threading.Lock:
+    with _stuck_probes_lock:
+        return _PROBE_LOCKS.setdefault(device_id, threading.Lock())
 
 
 class StepHangError(RuntimeError):
@@ -131,13 +145,6 @@ def device_health(
     for d in devices:
         entry: Dict[str, Any] = {"id": d.id, "platform": d.platform, "ok": True,
                                  "latency_ms": None, "error": None}
-        stuck = _STUCK_PROBES.get(d.id)
-        if stuck is not None and stuck.is_alive():
-            entry = {**entry, "ok": False,
-                     "error": "previous probe still wedged; not re-probing"}
-            report.append(entry)
-            continue
-
         def _probe(entry=entry, d=d):
             t0 = time.perf_counter()
             try:
@@ -150,22 +157,36 @@ def device_health(
                 entry["ok"] = False
                 entry["error"] = f"{type(e).__name__}: {e}"[:200]
 
-        if deadline is None:
-            _probe()
-        else:
-            t = threading.Thread(target=_probe, daemon=True,
-                                 name=f"tdx-health-probe-{d.id}")
-            t.start()
-            t.join(deadline)
-            if t.is_alive():
-                _STUCK_PROBES[d.id] = t
-                # Fresh dict: whatever the abandoned thread writes later
-                # must not flip a verdict already reported.
-                entry = {**entry, "ok": False, "latency_ms": None,
-                         "error": f"probe timed out after {deadline}s "
-                                  f"(device wedged?)"}
+        # The per-device lock spans check → probe → register, so N
+        # concurrent health checks serialize on each device (each waits
+        # at most its predecessor's deadline) instead of all passing the
+        # stuck-check and leaking one abandoned thread apiece.
+        with _probe_lock(d.id):
+            with _stuck_probes_lock:
+                stuck = _STUCK_PROBES.get(d.id)
+            if stuck is not None and stuck.is_alive():
+                entry = {**entry, "ok": False,
+                         "error": "previous probe still wedged; not re-probing"}
+                report.append(entry)
+                continue
+            if deadline is None:
+                _probe()
             else:
-                _STUCK_PROBES.pop(d.id, None)
+                t = threading.Thread(target=_probe, daemon=True,
+                                     name=f"tdx-health-probe-{d.id}")
+                t.start()
+                t.join(deadline)
+                if t.is_alive():
+                    with _stuck_probes_lock:
+                        _STUCK_PROBES[d.id] = t
+                    # Fresh dict: whatever the abandoned thread writes
+                    # later must not flip a verdict already reported.
+                    entry = {**entry, "ok": False, "latency_ms": None,
+                             "error": f"probe timed out after {deadline}s "
+                                      f"(device wedged?)"}
+                else:
+                    with _stuck_probes_lock:
+                        _STUCK_PROBES.pop(d.id, None)
         report.append(entry)
     return {"healthy": all(e["ok"] for e in report), "devices": report}
 
@@ -420,6 +441,7 @@ def run_elastic(
     last_saved: Optional[int] = None
     drain = {"requested": False}
     drained = False
+    drain_ok = True
     async_saver = None
     pending_async: Optional[Tuple[int, str]] = None
     if async_checkpoints and checkpoint_dir is not None:
@@ -485,18 +507,21 @@ def run_elastic(
             pending_async = None
             _finalize(s, p)
 
-    def save(step_now: int, state_now: Any, *, sync: bool = False) -> None:
+    def save(step_now: int, state_now: Any, *, sync: bool = False) -> bool:
+        """Returns False when a SYNC save landed corrupt (quarantined);
+        async saves report True — their durability verdict arrives at the
+        next commit."""
         nonlocal pending_async
         if checkpoint_dir is None:
-            return
+            return True
         path = _ckpt_path(step_now)
         _commit_pending()
         if async_saver is not None and not sync:
             async_saver.save(path, state_now)
             pending_async = (step_now, path)
-        else:
-            save_checkpoint(path, state_now)
-            _finalize(step_now, path)
+            return True
+        save_checkpoint(path, state_now)
+        return _finalize(step_now, path)
 
     def _restore_best(verify_window: bool) -> Tuple[int, Any]:
         """Newest verified checkpoint on disk, quarantining every corrupt
@@ -601,23 +626,46 @@ def run_elastic(
             raise box["error"]
         return box["result"]
 
-    def _drain_now() -> None:
+    def _drain_now() -> bool:
+        """Drain on the preemption notice; returns whether the final
+        checkpoint is durable AND verified.  A drain save that lands
+        corrupt (quarantined by _finalize) must NOT advertise a clean
+        exit: CLEAN_EXIT.json is the relauncher's promise that
+        ``resume=True`` continues at exactly this step, and the
+        quarantined checkpoint cannot honor it — resume must fall back
+        to the previous verified step instead."""
         log.warning(
             "run_elastic: preemption notice received; draining at step %d",
             step,
         )
         observe.counter("tdx.elastic.drains").inc()
         observe.instant("elastic.drain", category="elastic", step=step)
+        ok = True
         if checkpoint_dir is not None:
             _commit_pending()
             if last_saved != step:
-                save(step, state, sync=True)  # must be durable before exit
-            with open(os.path.join(checkpoint_dir, CLEAN_EXIT_MARKER), "w") as f:
-                json.dump(
-                    {"step": step, "reason": "sigterm-drain",
-                     "pid": os.getpid(), "time": time.time()},
-                    f,
+                ok = save(step, state, sync=True)  # durable before exit
+            if ok:
+                with open(
+                    os.path.join(checkpoint_dir, CLEAN_EXIT_MARKER), "w"
+                ) as f:
+                    json.dump(
+                        {"step": step, "reason": "sigterm-drain",
+                         "pid": os.getpid(), "time": time.time()},
+                        f,
+                    )
+            else:
+                observe.counter("tdx.elastic.drain_failures").inc()
+                observe.instant(
+                    "elastic.drain_failure", category="elastic", step=step
                 )
+                log.error(
+                    "run_elastic: drain checkpoint at step %d failed "
+                    "verification and was quarantined; NOT writing %s — "
+                    "resume will use the previous verified checkpoint "
+                    "(step %s)", step, CLEAN_EXIT_MARKER, last_saved,
+                )
+        return ok
 
     prev_handler: Any = None
     handler_installed = False
@@ -661,7 +709,7 @@ def run_elastic(
 
         while True:
             if drain["requested"]:
-                _drain_now()
+                drain_ok = _drain_now()
                 drained = True
                 break
             batch = window.get(step + 1)
@@ -703,6 +751,15 @@ def run_elastic(
             finally:
                 async_saver.close()
     if drained and exit_on_drain:
+        if not drain_ok:
+            # Exit 0 is the relauncher's lossless-resume signal; a
+            # quarantined drain checkpoint cannot honor it.
+            log.error(
+                "run_elastic: drain checkpoint failed verification; "
+                "exiting 1 at step %d (resume falls back to the previous "
+                "verified checkpoint)", step,
+            )
+            sys.exit(1)
         log.info("run_elastic: clean drain exit at step %d (rc 0)", step)
         sys.exit(0)
     return state, step, restarts
