@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -47,10 +47,20 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test:
+chaos-test: registry-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
+	    tests/test_registry.py \
 	    -q -p no:cacheprovider
+
+# Pod-scale registry smoke (docs/registry.md): a 2-process sharded warm
+# against a shared artifact registry — disjoint compile shards verified
+# from each process's per-program outcome report — then a fresh process
+# with an EMPTY local TDX_CACHE_DIR that must materialize with zero
+# local compiles (every program a registry fetch hit) and bitwise-equal
+# outputs.  CPU, bounded; part of `make chaos-test`.
+registry-smoke:
+	timeout -k 10 420 bash scripts/registry_smoke.sh
 
 # One short materialize-recovery soak cycle under tier-1 constraints
 # (CPU, bounded wall clock): drives the self-healing materialization

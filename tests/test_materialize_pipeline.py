@@ -343,12 +343,14 @@ class TestWarmCacheTool:
         # per-group set: the partial cache must stay USABLE — each entry
         # commits independently, so a torn warm is "fewer hits", never a
         # poisoned dir that later compiles trip over.
+        from torchdistx_tpu.registry import scheduler as sched
+
         wc = self._load_tool()
 
         def boom(*a, **k):
             raise RuntimeError("interrupted warm (injected)")
 
-        monkeypatch.setattr(mat, "lower_init_groups", boom)
+        monkeypatch.setattr(sched, "plan_group_specs", boom)
         with pytest.raises(RuntimeError, match="interrupted warm"):
             wc.warm(wc._demo_model, fresh_cache)
         monkeypatch.undo()

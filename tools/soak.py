@@ -33,6 +33,18 @@ parameters are bitwise-equal to the fault-free run:
     python tools/soak.py --modes materialize --seconds 300 \\
         --fault-plan 'compile@1=raise;cache@2=corrupt:truncate'
 
+The ``registry`` mode soaks the pod-scale compile-artifact registry
+(docs/registry.md): each seed publishes a randomized model's init
+programs through one materialization, then re-materializes from a fresh
+local cache through the shared registry under an injected ``registry``
+fault plan (flaky fetch/publish, slow shared filesystem, artifact
+bit-rot caught by CRC self-verification and quarantine) and asserts the
+final parameters are bitwise-equal to the fault-free run — registry
+trouble must only ever cost local compiles, never correctness:
+
+    python tools/soak.py --modes registry --seconds 300 \\
+        --fault-plan 'registry@1=raise;registry@2=corrupt:flip'
+
 Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
 exception) and the exit code is non-zero if any occurred.
 """
@@ -50,7 +62,8 @@ import traceback
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
-         "geom", "geom_single", "geom_bridge", "elastic", "materialize")
+         "geom", "geom_single", "geom_bridge", "elastic", "materialize",
+         "registry")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -252,6 +265,90 @@ def _materialize_oracle(seed: int, plan_text: "str | None"):
     return None
 
 
+def _registry_oracle(seed: int, plan_text: "str | None"):
+    """One registry-degradation run: publish a seeded model's init
+    programs through the shared artifact registry, then re-materialize
+    from a FRESH local cache through the registry under an injected
+    ``registry`` fault plan (raise / slow / corrupt on fetch and
+    publish) and assert the final parameters are bitwise-equal to the
+    fault-free run — a flaky or bit-rotted shared filesystem degrades to
+    local compiles (quarantined + counted), never to an error or a wrong
+    value."""
+    import random
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import torch
+
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu.deferred_init import deferred_init
+    from torchdistx_tpu.jax_bridge import materialize_module_jax
+    from torchdistx_tpu.jax_bridge import materialize as mat
+
+    rng = random.Random(seed)
+    k = rng.randrange(9, 13)
+    widths = [8 + 4 * rng.randrange(1, 8) for _ in range(k)]
+
+    class Model(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = torch.nn.ModuleList(
+                torch.nn.Linear(widths[i], widths[(i + 1) % k])
+                for i in range(k)
+            )
+
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        kind = rng.choice(["raise", "slow", "corrupt"])
+        arg = {"slow": ":0.1", "corrupt": ":" + rng.choice(
+            ["truncate", "flip"])}.get(kind, "")
+        group = rng.randrange(1, 4)
+        count = rng.randrange(1, 3)
+        plan = chaos.parse_plan(f"registry@{group}={kind}{arg} x{count}")
+
+    reg_dir = tempfile.mkdtemp(prefix="tdx_soak_reg_")
+    cache_a = tempfile.mkdtemp(prefix="tdx_soak_reg_ca_")
+    cache_b = tempfile.mkdtemp(prefix="tdx_soak_reg_cb_")
+    try:
+        module = deferred_init(Model)
+        with tdx_config.override(materialize_pipeline="off"):
+            baseline = {
+                k_: np.asarray(v) for k_, v in
+                materialize_module_jax(module, seed=seed).items()
+            }
+        # Publish pass: fault-free, fills the registry (corrupt faults
+        # need real artifacts to damage).
+        mat._reset_cache_binding()
+        with tdx_config.override(
+            materialize_pipeline="auto", cache_dir=cache_a,
+            registry_dir=reg_dir, compile_workers=2,
+        ):
+            materialize_module_jax(module, seed=seed)
+
+        chaos.install(plan)
+        mat._reset_cache_binding()
+        with tdx_config.override(
+            materialize_pipeline="auto", cache_dir=cache_b,
+            registry_dir=reg_dir, compile_workers=2,
+            materialize_retries=2,
+        ):
+            params = materialize_module_jax(module, seed=seed)
+        for name, want in baseline.items():
+            got = np.asarray(params[name])
+            if not np.array_equal(want, got):
+                return ("mismatch", f"{name} differs plan={plan!r}")
+    finally:
+        chaos.clear()
+        mat._reset_cache_binding()
+        shutil.rmtree(reg_dir, ignore_errors=True)
+        shutil.rmtree(cache_a, ignore_errors=True)
+        shutil.rmtree(cache_b, ignore_errors=True)
+    return None
+
+
 def _run_seed(mode: str, seed: int):
     """Run one oracle; returns None on pass/skip, (kind, message) else."""
     import random
@@ -305,6 +402,10 @@ def _run_seed(mode: str, seed: int):
             r = _materialize_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
+        elif mode == "registry":
+            r = _registry_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "serialize":
             import tempfile
             from pathlib import Path
@@ -343,8 +444,8 @@ def main() -> int:
     ap.add_argument("--log", default=os.path.join(REPO, "tools",
                                                   "soak_failures.jsonl"))
     ap.add_argument("--fault-plan", default=None,
-                    help="chaos plan for --modes elastic/materialize "
-                         "(grammar: torchdistx_tpu.chaos / "
+                    help="chaos plan for --modes elastic/materialize/"
+                         "registry (grammar: torchdistx_tpu.chaos / "
                          "docs/robustness.md); default: a seeded-random "
                          "plan per seed")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",

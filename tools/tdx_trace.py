@@ -129,6 +129,30 @@ def summarize(events: List[dict], top: int = 15) -> str:
     else:
         lines.append("compile cache: no compile events recorded")
 
+    # Artifact-registry digest (docs/registry.md vocabulary), alongside
+    # the compile-cache ratio it feeds: a healthy pod shows registry
+    # fetch hits ≈ compile-cache hits on every host but the publishers.
+    r_hit = counters.get("tdx.registry.fetch_hit", 0.0)
+    r_miss = counters.get("tdx.registry.fetch_miss", 0.0)
+    r_pub = counters.get("tdx.registry.publish", 0.0)
+    if r_hit or r_miss or r_pub:
+        denom = r_hit + r_miss
+        ratio = f"{r_hit / denom:.0%}" if denom else "n/a"
+        parts = [
+            f"registry: {int(r_hit)} fetch hit / {int(r_miss)} miss "
+            f"({ratio} hit ratio), {int(r_pub)} published",
+        ]
+        for label, key in (("stolen", "tdx.registry.steals"),
+                           ("verify failures", "tdx.registry.verify_fail"),
+                           ("publish errors", "tdx.registry.publish_errors")):
+            v = counters.get(key, 0.0)
+            if v:
+                parts.append(f"{int(v)} {label}")
+        mb_f = counters.get("tdx.registry.bytes_fetched", 0.0) / 1e6
+        mb_p = counters.get("tdx.registry.bytes_published", 0.0) / 1e6
+        parts.append(f"{mb_f:.1f} MB fetched / {mb_p:.1f} MB published")
+        lines.append(", ".join(parts))
+
     # Counter preferred; the instant events are the same occurrences
     # (counting both would double), and only the exact platform event
     # qualifies — bench.cache_fallback is a different condition.

@@ -17,17 +17,36 @@ BOTH program sets are warmed so either engine mode starts hot:
   matches exactly.  Warm with the same ``TDX_COMPILE_WORKERS`` (and mesh
   / plan / param_dtype) the consumer will run with.
 
+**Pod-scale sharded warm** (``--hosts N --host-id i --registry-dir R``,
+docs/registry.md): run one invocation per host against a shared
+registry directory and each host compiles only its deterministic shard
+of the program set, publishes the executables, and fills the rest from
+what the other hosts published — O(model / hosts) compile per host.  A
+program whose owner never publishes is stolen after ``--steal-after``
+seconds, so a dead host degrades the warm instead of hanging it.  With
+``--registry-dir`` alone (hosts=1) the warm still publishes everything,
+seeding the registry for later consumers.
+
+Every program reports its own outcome (``published`` / ``compiled`` /
+``fetched`` / ``cached`` / ``stolen`` / ``unwarmed``), one line each,
+followed by a summary JSON line; the exit status is non-zero if ANY
+program ended unwarmed.
+
 Usage::
 
     python tools/warm_cache.py --model gpt2 --cache-dir .jax_cache
     python tools/warm_cache.py --model llama-1b9 --cache-dir /nfs/cache \\
         --host-devices 8 --mesh fsdp=4,tp=2 --param-dtype bfloat16
     python tools/warm_cache.py --module mypkg.models:build --cache-dir d
+    python tools/warm_cache.py --model gpt2 --cache-dir .jax_cache \\
+        --registry-dir /nfs/tdx_registry --hosts 4 --host-id 2
 
 Cache-key caveats: entries are keyed on backend, topology, and compile
 options — warm on the platform (and device count) the consumer will see.
 XLA:CPU entries are additionally host-ISA-specific AOT code (bench.py
-partitions its CPU cache by ISA tag for exactly this reason).
+partitions its CPU cache by ISA tag for exactly this reason).  The
+registry composes the same identity into its keys (``registry.env_key``),
+so a mismatched fetch is impossible by construction.
 """
 
 from __future__ import annotations
@@ -37,7 +56,6 @@ import importlib
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -68,6 +86,20 @@ def _parse_args(argv):
                    help="warm only the whole-model program")
     p.add_argument("--skip-whole", action="store_true",
                    help="warm only the per-group programs")
+    p.add_argument("--registry-dir", default=None,
+                   help="shared compile-artifact registry directory "
+                        "(docs/registry.md); programs are fetched from and "
+                        "published to it")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="total hosts participating in a sharded warm "
+                        "(requires --registry-dir when > 1)")
+    p.add_argument("--host-id", type=int, default=0,
+                   help="this host's 0-based id in [0, hosts)")
+    p.add_argument("--steal-after", type=float, default=120.0,
+                   help="seconds to wait for another host's artifact "
+                        "before compiling it locally (work stealing)")
+    p.add_argument("--poll", type=float, default=0.5,
+                   help="registry polling interval during the fill phase")
     return p.parse_args(argv)
 
 
@@ -133,15 +165,15 @@ def _parse_mesh(spec):
 
 
 def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
-         skip_whole=False, skip_groups=False) -> dict:
-    """Compile a module factory's init programs into ``cache_dir``;
-    returns a summary dict.  Importable (the tests drive it in-process);
-    ``main`` is the CLI shell around it."""
-    import jax
-
-    import torchdistx_tpu.config as tdx_config
-    from torchdistx_tpu.deferred_init import deferred_init
-    from torchdistx_tpu.jax_bridge import materialize as mat
+         skip_whole=False, skip_groups=False, registry_dir=None,
+         hosts=1, host_id=0, steal_after_s=120.0, poll_s=0.5) -> dict:
+    """Compile a module factory's init programs into ``cache_dir`` (and,
+    when ``registry_dir`` is set, exchange them through the shared
+    artifact registry — sharded across ``hosts`` by
+    :func:`torchdistx_tpu.registry.warm_sharded`); returns a summary
+    dict with per-program outcome reports.  Importable (the tests drive
+    it in-process); ``main`` is the CLI shell around it."""
+    from torchdistx_tpu.registry import warm_sharded
 
     # Fail fast on an unusable cache dir: jax itself degrades cache-WRITE
     # errors to warnings, so without this probe the tool would burn the
@@ -164,49 +196,23 @@ def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
     # this run claims to have warmed (explicit env wins; the prior value
     # is restored on exit — warm() is documented as importable, and an
     # in-process caller must keep the documented persist boundary).
+    # Publishing rides on the same boundary: only persisted entries can
+    # be published to the registry.
     prior_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
     os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
-    t0 = time.perf_counter()
-    module = deferred_init(factory)
-    summary = {"programs": 0, "outputs": 0}
     try:
-        with tdx_config.override(cache_dir=cache_dir):
-            mat._reset_cache_binding()  # bind THIS dir even mid-process
-            mat._maybe_enable_cache()
-            opts = mat._compiler_options()
-
-            def compile_one(lowered, names):
-                (
-                    lowered.compile(compiler_options=opts)
-                    if opts is not None else lowered.compile()
-                )
-                summary["programs"] += 1
-                summary["outputs"] += len(names)
-
-            if not skip_whole:
-                lowered, names = mat.lower_init_module(
-                    module, mesh=mesh, plan=plan, param_dtype=param_dtype
-                )
-                compile_one(lowered, names)
-            if not skip_groups:
-                for lowered, names in mat.lower_init_groups(
-                    module, mesh=mesh, plan=plan, param_dtype=param_dtype
-                ):
-                    compile_one(lowered, names)
+        return warm_sharded(
+            factory, cache_dir, registry_dir=registry_dir,
+            hosts=hosts, host_id=host_id, mesh=mesh, plan=plan,
+            param_dtype=param_dtype, skip_whole=skip_whole,
+            skip_groups=skip_groups, steal_after_s=steal_after_s,
+            poll_s=poll_s,
+        )
     finally:
-        mat._reset_cache_binding()
         if prior_min is None:
             os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
         else:
             os.environ["TDX_CACHE_MIN_COMPILE_S"] = prior_min
-    try:
-        summary["cache_entries"] = len(os.listdir(cache_dir))
-    except OSError:
-        summary["cache_entries"] = 0
-    summary["seconds"] = round(time.perf_counter() - t0, 2)
-    summary["backend"] = jax.default_backend()
-    summary["cache_dir"] = cache_dir
-    return summary
 
 
 def main(argv=None) -> None:
@@ -238,9 +244,27 @@ def main(argv=None) -> None:
     summary = warm(
         _model_factory(args), args.cache_dir, mesh=mesh, plan=plan,
         param_dtype=param_dtype, skip_whole=args.skip_whole,
-        skip_groups=args.skip_groups,
+        skip_groups=args.skip_groups, registry_dir=args.registry_dir,
+        hosts=args.hosts, host_id=args.host_id,
+        steal_after_s=args.steal_after, poll_s=args.poll,
     )
+    for rep in summary.get("program_reports", []):
+        line = (f"warm: program={rep['program']} outputs={rep['outputs']} "
+                f"outcome={rep['outcome']}")
+        if "cache" in rep:
+            line += f" cache={rep['cache']}"
+        if "owner" in rep and args.hosts > 1:
+            line += f" owner={rep['owner']}"
+        line += f" {rep['seconds']:.2f}s"
+        if "error" in rep:
+            line += f" error={rep['error']}"
+        print(line, file=sys.stderr)
     print(json.dumps(summary))
+    if summary.get("unwarmed"):
+        # Partial warms must FAIL the invocation: a deployment script
+        # that gates rollout on this tool needs "every program warmed"
+        # to be the zero-exit contract, not a line in the JSON.
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

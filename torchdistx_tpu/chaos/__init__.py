@@ -41,7 +41,12 @@ Fault kinds and what they model:
 The materialization sites fire inside the record→compile→materialize
 pipeline (:mod:`torchdistx_tpu.jax_bridge.materialize`), keyed by the
 1-based program-group number instead of the training step (the
-monolithic engine is group 1); see docs/robustness.md.
+monolithic engine is group 1); see docs/robustness.md.  The
+``registry`` site fires inside the shared compile-artifact registry's
+fetch and publish operations (:mod:`torchdistx_tpu.registry`), same
+group-number keying; ``corrupt`` there damages the published artifacts
+(:func:`corrupt_registry_dir`) so the CRC self-verification and
+quarantine path is exercised for real.
 """
 
 from __future__ import annotations
@@ -53,6 +58,7 @@ from .inject import (
     InjectedRuntimeError,
     corrupt_cache_dir,
     corrupt_checkpoint,
+    corrupt_registry_dir,
     execute,
     set_cancel_event,
 )
@@ -68,6 +74,7 @@ __all__ = [
     "clear",
     "corrupt_cache_dir",
     "corrupt_checkpoint",
+    "corrupt_registry_dir",
     "install",
     "maybe_inject",
     "parse_plan",

@@ -104,11 +104,14 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
     if fault.kind == "corrupt":
         if path is None:
             raise ValueError(
-                f"corrupt fault needs a target path (checkpoint dir, or the "
-                f"persistent compile-cache dir at materialization sites): "
+                f"corrupt fault needs a target path (checkpoint dir, the "
+                f"persistent compile-cache dir at materialization sites, or "
+                f"the shared registry dir at the registry site): "
                 f"{fault.spec()}"
             )
-        if fault.site in _CACHE_SITES:
+        if fault.site == "registry":
+            corrupt_registry_dir(path, mode=fault.arg or "truncate")
+        elif fault.site in _CACHE_SITES:
             corrupt_cache_dir(path, mode=fault.arg or "truncate")
         else:
             corrupt_checkpoint(path, mode=fault.arg or "truncate")
@@ -154,6 +157,33 @@ def corrupt_cache_dir(path: "str | Path", mode: str = "truncate") -> "list[str]"
     for f in victims:
         _damage_file(f, mode)
     return [f.name for f in victims]
+
+
+def corrupt_registry_dir(path: "str | Path", mode: str = "truncate") -> "list[str]":
+    """Deterministically damage the PAYLOAD files of every complete entry
+    in a shared compile-artifact registry (the bit-rotted / torn shared
+    filesystem model).  Manifests are left intact so the damage is
+    exactly what CRC self-verification exists to catch: the next fetch
+    must verify-fail, quarantine the entry, and degrade to a local
+    compile.  Returns the damaged ``<entry>/<file>`` names."""
+    if mode not in _CORRUPT_MODES:
+        raise ValueError(f"corrupt mode must be one of {_CORRUPT_MODES}, got {mode!r}")
+    path = Path(path)
+    victims: "list[str]" = []
+    if path.is_dir():
+        for entry in sorted(path.iterdir()):
+            if not entry.is_dir() or entry.name.endswith(".corrupt"):
+                continue
+            if not (entry / "meta.json").is_file():
+                continue  # incomplete/tmp dir: publish owns it
+            for f in sorted(entry.iterdir()):
+                if f.name == "meta.json" or not f.is_file():
+                    continue
+                _damage_file(f, mode)
+                victims.append(f"{entry.name}/{f.name}")
+    if not victims:
+        raise FileNotFoundError(f"no registry artifacts to corrupt under {path}")
+    return victims
 
 
 def corrupt_checkpoint(path: "str | Path", mode: str = "truncate") -> str:

@@ -13,6 +13,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
     entry := site '@' step '=' kind [':' arg] ['x' count]
     site  := 'step' | 'save' | 'restore'            (elastic loop)
            | 'lower' | 'compile' | 'execute' | 'cache'  (materialization)
+           | 'registry'                             (artifact registry)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
 
 Examples::
@@ -25,6 +26,8 @@ Examples::
     step@4=raise x2              # fires the first TWO times step 4 runs
     compile@1=hang:3600          # group 1's XLA compile wedges (watchdog)
     cache@1=corrupt:truncate     # damage the on-disk compile-cache entries
+    registry@2=raise             # group 2's registry fetch/publish fails
+    registry@1=corrupt:flip      # bit-rot the shared registry's artifacts
 
 Each entry fires ``count`` times (default 1) and is then spent — a
 restarted step re-executes fault-free, which is what makes
@@ -33,7 +36,12 @@ recover-and-converge scenarios terminate.  ``corrupt`` args are
 At the materialization sites ``corrupt`` damages the persistent XLA
 compile-cache entries on disk (the bad-cache-entry model) and the
 "step" is the 1-based program-group number (the monolithic engine is
-group 1); see docs/robustness.md.
+group 1); see docs/robustness.md.  The ``registry`` site fires inside
+the artifact registry's fetch AND publish operations (group-number
+keyed like the other materialization sites); ``corrupt`` there damages
+the shared registry's published artifacts (use kinds ``raise`` /
+``slow`` / ``corrupt`` — both operations degrade to a local compile,
+so an injected registry fault costs savings, never correctness).
 """
 
 from __future__ import annotations
@@ -43,7 +51,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache")
+SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
+         "registry")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
 
 _ENTRY_RE = re.compile(

@@ -18,6 +18,13 @@ Environment variables (read at first import):
                         the library is built).
 ``TDX_CACHE_DIR``       Persistent XLA compilation-cache directory used by
                         the jax bridge's materializers ("" disables).
+``TDX_REGISTRY_DIR``    Shared compile-artifact registry directory
+                        (:mod:`torchdistx_tpu.registry`): when set (and a
+                        local ``TDX_CACHE_DIR`` is bound), both
+                        materialization engines fetch published init-program
+                        executables from it before compiling and publish
+                        what they compile — the pod-scale warm path (""
+                        disables; see docs/registry.md).
 ``TDX_RNG_CHUNK``       Row-chunk element count for large RNG draws in the
                         jax bridge (compile-time control; see
                         jax_bridge/ops.py).
@@ -89,6 +96,7 @@ __all__ = ["Config", "bind", "get", "override", "set_flags"]
 class Config:
     native: bool = True
     cache_dir: Optional[str] = None
+    registry_dir: Optional[str] = None
     rng_chunk_elems: int = 1 << 20
     log_level: str = "INFO"
     trace_dir: Optional[str] = None
@@ -106,6 +114,7 @@ def _from_env() -> Config:
     return Config(
         native=os.environ.get("TDX_NATIVE", "1") != "0",
         cache_dir=cache or None,
+        registry_dir=os.environ.get("TDX_REGISTRY_DIR", "") or None,
         rng_chunk_elems=int(os.environ.get("TDX_RNG_CHUNK", str(1 << 20))),
         log_level=os.environ.get("TDX_LOG_LEVEL", "INFO"),
         trace_dir=os.environ.get("TDX_TRACE_DIR", "") or None,

@@ -33,6 +33,15 @@ progress manifest so an interrupted materialization (fault or SIGTERM)
 resumes where it left off instead of re-tracing the whole model.  Total
 failure raises a typed :class:`MaterializationError` carrying which
 groups succeeded.
+
+With ``TDX_REGISTRY_DIR`` set (and a local ``TDX_CACHE_DIR`` bound), both
+engines additionally consult the **pod-scale artifact registry**
+(:mod:`..registry`, docs/registry.md) around every program compile: a
+published executable for the same program fingerprint and compile
+environment is fetched, CRC-verified, and installed into the local
+persistent cache so the compile becomes an ordinary local hit; a program
+compiled locally is published back for the rest of the fleet.  Registry
+trouble of any kind degrades to a local compile, never an error.
 """
 
 from __future__ import annotations
@@ -182,6 +191,20 @@ def _maybe_enable_cache() -> None:
         if cache_dir:
             _install_cache_guard()
             jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # jax ≥0.4.36 embeds the cache-dir PATH into CompileOptions
+            # (debug_options.xla_gpu_per_fusion_autotune_cache_dir) when
+            # the persistent cache is on — which makes the compile-cache
+            # key a function of the LOCAL PATH, so a cache warmed under
+            # one directory (a login host, the artifact registry's
+            # install target) could never be hit from another.  The
+            # XLA-side caches are GPU-only amenities; disable them so
+            # cache keys are path-independent and cross-host stable.
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_enable_xla_caches", "none"
+                )
+            except Exception:
+                pass
             # TDX_CACHE_MIN_COMPILE_S=0 persists even trivial programs —
             # tests use it to exercise the compile-cache hit/miss telemetry
             # deterministically with toy models.
@@ -262,10 +285,80 @@ def _quarantine_cache_entry(cache_key: str) -> List[str]:
     return moved
 
 
+def _note_cache_key(cache_key: str) -> None:
+    """Record a jax persistent-cache key touched by the compile running
+    on THIS thread (both the get and put wrappers report here).  The
+    registry publish path reads the recorded keys to know which on-disk
+    cache entries the just-finished compile corresponds to."""
+    rec = getattr(_mon_tls, "cache_keys", None)
+    if rec is not None and cache_key not in rec:
+        rec.append(cache_key)
+
+
+def _registry_direct_serve(cache_key, compile_options, backend):
+    """Serve the current compile's executable straight from the fetched
+    registry artifact when the local cache load missed.
+
+    The registry installs artifacts under the jax cache-key names their
+    PUBLISHER computed, but jax's key is not perfectly stable across
+    traces and processes (it hashes serialized compile options whose
+    incidental fields can drift) — while the registry's content address
+    is, and it already pinned "same recorded computation, same output
+    contract, same compile environment".  So a key mismatch must cost a
+    rename, not a recompile: deserialize the artifact's payload with
+    THIS compile's options and also install it under the key THIS
+    process computes, healing the local cache for later compiles.  The
+    caller records the normal cache-hit monitoring event, so outcome
+    accounting sees an ordinary hit."""
+    payloads = getattr(_mon_tls, "registry_payload", None)
+    if not payloads:
+        return None, None
+    from jax._src import compilation_cache as _cc
+
+    for data in payloads:
+        try:
+            serialized, compile_time = _cc.extract_executable_and_time(
+                _cc.decompress_executable(data)
+            )
+            executable = backend.deserialize_executable(
+                serialized, compile_options
+            )
+        except Exception as e:  # noqa: BLE001 — wrong/unloadable payload
+            get_logger().debug(
+                "registry: direct-serve payload rejected (%s: %s)",
+                type(e).__name__, str(e)[:120],
+            )
+            continue
+        d = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if d:
+            # LRUCache naming; on a jax whose cache stores bare keys the
+            # healed file is inert junk, and direct-serve still served.
+            dst = os.path.join(d, f"{cache_key}-cache")
+            tmp = f"{dst}.tdx-tmp-{os.getpid()}-{threading.get_ident()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, dst)
+            except OSError:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        observe.counter("tdx.registry.direct_serves").inc()
+        observe.instant(
+            "registry.direct_serve", category="registry",
+            key=cache_key[:40],
+        )
+        return executable, (compile_time if compile_time is not None else 0)
+    return None, None
+
+
 def _install_cache_guard() -> bool:
     """Wrap ``jax._src.compilation_cache.get_executable_and_time`` with
-    the quarantine-on-corrupt behavior; installed once per process, a
-    no-op when jax's internals moved (False)."""
+    the quarantine-on-corrupt behavior (plus cache-key recording for the
+    artifact registry, also hooked into ``put_executable_and_time``);
+    installed once per process, a no-op when jax's internals moved
+    (False)."""
     global _cache_guard_state
     with _cache_guard_lock:
         if _cache_guard_state is not None:
@@ -274,10 +367,18 @@ def _install_cache_guard() -> bool:
             from jax._src import compilation_cache as _cc
 
             _orig = _cc.get_executable_and_time
+            _orig_put = _cc.put_executable_and_time
+
+            def _recording_put(cache_key, module_name, executable, backend,
+                               compile_time):
+                _note_cache_key(cache_key)
+                return _orig_put(cache_key, module_name, executable,
+                                 backend, compile_time)
 
             def _guarded(cache_key, compile_options, backend):
+                _note_cache_key(cache_key)
                 try:
-                    return _orig(cache_key, compile_options, backend)
+                    result = _orig(cache_key, compile_options, backend)
                 except Exception as e:  # noqa: BLE001 — any load error
                     moved = _quarantine_cache_entry(cache_key)
                     observe.counter("tdx.jax.cache_quarantined").inc(
@@ -294,9 +395,17 @@ def _install_cache_guard() -> bool:
                         cache_key, type(e).__name__, str(e)[:120],
                         [m + ".corrupt" for m in moved] or "(file gone)",
                     )
-                    return None, None  # a miss: the caller recompiles
+                    result = (None, None)  # a miss: the caller recompiles
+                if result[0] is None:
+                    # Local miss (or quarantine): a verified registry
+                    # artifact staged for this compile serves it directly.
+                    result = _registry_direct_serve(
+                        cache_key, compile_options, backend
+                    )
+                return result
 
             _cc.get_executable_and_time = _guarded
+            _cc.put_executable_and_time = _recording_put
             _cache_guard_state = True
         except Exception:  # pragma: no cover — jax internals moved
             _cache_guard_state = False
@@ -500,6 +609,68 @@ def _persistent_cache_entries() -> Optional[set]:
         return set()
 
 
+# -- pod-scale artifact registry (docs/registry.md) --------------------------
+#
+# With TDX_REGISTRY_DIR set, every program compile consults the shared
+# content-addressed registry: fetch→verify→install the published
+# executable into the local persistent cache BEFORE compiling (the
+# compile then loads it as an ordinary local hit), and publish the local
+# cache entry AFTER a compile that produced one.  The registry key
+# composes the program's content fingerprint (_registry_program_fp —
+# seed-independent: the PRNG key is a runtime argument) with the
+# compile-environment identity (registry.env_key).  Every registry
+# failure mode degrades to a local compile.
+
+_registry_nocache_warned = False
+
+
+def _registry_program_fp(fake_list, idxs, out_shardings, param_dtype,
+                         cast_mask) -> Optional[str]:
+    """Registry key material for one init program: the cross-process
+    content fingerprint of the group's recorded computation
+    (:func:`..compile.group_fingerprint`) composed with the output
+    contract (cast policy, planned shardings) — everything the compiled
+    executable depends on EXCEPT the runtime PRNG key, so one artifact
+    serves every seed.  None when no stable fingerprint exists (the
+    program is then simply not registry-eligible)."""
+    import hashlib
+
+    try:
+        structural = group_fingerprint([fake_list[i] for i in idxs])
+    except Exception:  # noqa: BLE001 — unstable chain: compile locally
+        return None
+    h = hashlib.sha1(b"tdx-program-fp-v1")
+    h.update(structural.encode())
+    for pos, i in enumerate(idxs):
+        osh = out_shardings[i] if out_shardings is not None else None
+        h.update(repr((pos, str(param_dtype), bool(cast_mask[i]),
+                       str(osh))).encode())
+    return h.hexdigest()
+
+
+def _active_registry():
+    """The configured :class:`..registry.ArtifactRegistry`, or None."""
+    from .. import config
+
+    rdir = config.get().registry_dir
+    if not rdir:
+        return None
+    from ..registry import ArtifactRegistry
+
+    return ArtifactRegistry(rdir)
+
+
+def _warn_registry_without_cache() -> None:
+    global _registry_nocache_warned
+    if not _registry_nocache_warned:
+        _registry_nocache_warned = True
+        get_logger().warning(
+            "TDX_REGISTRY_DIR is set but no local persistent cache is "
+            "bound (TDX_CACHE_DIR): registry fetches need a local cache "
+            "to install into — registry disabled for this run"
+        )
+
+
 def _cast_outputs(init_fn, param_dtype, mask=None):
     """Wrap ``init_fn`` so floating outputs are cast to ``param_dtype``
     INSIDE the compiled program: the standard TPU policy — compute init
@@ -552,7 +723,8 @@ def _set_run_stats(**kw) -> None:
 
 
 def _compile_program(init_fn, key, out_shardings, label=None, *,
-                     fault_plan=None, deadline=None, bypass_cache=False):
+                     fault_plan=None, deadline=None, bypass_cache=False,
+                     program_fp=None):
     """jit → lower → compile ONE init program; returns
     ``(compiled, lower_s, compile_s, cache_outcome)``.  Safe to call from
     several threads at once — jax tracing is thread-local and the cache
@@ -561,10 +733,14 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
     thread, so the record is installed there, not on the caller).
 
     ``fault_plan`` pins the chaos plan for the ``lower`` / ``cache`` /
-    ``compile`` injection sites (group-number keyed; the monolith is
-    group 1); ``deadline`` arms the stage watchdog; ``bypass_cache``
-    compiles with the persistent cache unbound — the ladder's
-    fresh-compile rung."""
+    ``compile`` / ``registry`` injection sites (group-number keyed; the
+    monolith is group 1); ``deadline`` arms the stage watchdog;
+    ``bypass_cache`` compiles with the persistent cache unbound — the
+    ladder's fresh-compile rung (the registry is also skipped on that
+    rung: a poisoned artifact must not be able to fail every attempt).
+    ``program_fp`` makes the program registry-eligible: when a registry
+    is configured, its artifact is fetched into the local cache before
+    the compile and the local cache entry published after."""
     gno = label + 1 if isinstance(label, int) else 1
     if out_shardings is not None:
         jitted = jax.jit(init_fn, out_shardings=out_shardings)
@@ -589,14 +765,47 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
     # fault still pending on the final retry must target the REAL
     # configured dir, not fail on path=None.
     cdir = _chaos_cache_path()
+    reg = regkey = reg_payload = None
+    if program_fp is not None and not bypass_cache:
+        reg = _active_registry()
+        if reg is not None:
+            if cdir:
+                from ..registry import registry_key
+
+                regkey = registry_key(program_fp)
+                # Under the same watchdog as the stages proper: a
+                # blocking read on a dead shared filesystem is a hang
+                # the raise/slow/corrupt degrade paths cannot see, and
+                # the contract is that registry trouble costs savings,
+                # never liveness.  A timed-out fetch is just a miss.
+                try:
+                    reg_payload = _bounded_stage(
+                        "registry-fetch",
+                        lambda: reg.fetch_for_compile(
+                            regkey, cdir, gno=gno, plan=fault_plan
+                        ),
+                        deadline=deadline, group=gno,
+                    )
+                except CompileHangError:
+                    reg_payload = None
+            else:
+                _warn_registry_without_cache()
+                reg = None
     t0 = time.perf_counter()
     with observe.span("jax.compile", category="jax", **attrs) as csp:
         events: List[str] = []
+        cache_keys: List[str] = []
         before = None if exact else _persistent_cache_entries()
 
         def _do_compile():
             if exact:
                 _mon_tls.events = events
+            # Installed on whichever thread RUNS the compile (the
+            # watchdog may be an inner thread), exactly like `events`.
+            _mon_tls.cache_keys = cache_keys
+            _mon_tls.registry_payload = (
+                list(reg_payload.values()) if reg_payload else None
+            )
             try:
                 chaos.maybe_inject("cache", gno, path=cdir, plan=fault_plan)
                 chaos.maybe_inject("compile", gno, path=cdir, plan=fault_plan)
@@ -607,6 +816,8 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
             finally:
                 if exact:
                     _mon_tls.events = None
+                _mon_tls.cache_keys = None
+                _mon_tls.registry_payload = None
 
         if bypass_cache:
             with _cache_bypass():
@@ -630,6 +841,23 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
         csp.set(cache=outcome)
         if observe.enabled():
             observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
+    if reg is not None and outcome in ("hit", "miss") and cache_keys and cdir:
+        # Publish AFTER the compile regardless of hit/miss: a hit whose
+        # entry predates the registry (locally-warmed host, registry
+        # added later) still gets shared; has() inside skips duplicates.
+        # Watchdog-bounded like the fetch — a wedged publish must not
+        # hang a materialization that already has its executable.
+        try:
+            _bounded_stage(
+                "registry-publish",
+                lambda: reg.publish_from_cache(
+                    regkey, cdir, cache_keys, gno=gno, plan=fault_plan,
+                    meta={"program_fp": program_fp},
+                ),
+                deadline=deadline, group=gno,
+            )
+        except CompileHangError:
+            pass  # unpublished: some other host (or rerun) will
     return compiled, t_lower, time.perf_counter() - t0, outcome
 
 
@@ -654,7 +882,8 @@ def _execute_compiled(compiled, key, gno, *, deadline, fault_plan,
                        describe=f"execute of group {gno}")
 
 
-def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None):
+def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
+              program_fp=None):
     """Monolithic engine: one program, lower → compile → execute, each
     stage under the self-healing ladder (bounded retries with backoff;
     the final retry bypasses the persistent cache; a deadline-armed
@@ -680,6 +909,7 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None):
             init_fn, key, out_shardings, fault_plan=fault_plan,
             deadline=deadline,
             bypass_cache=(retries > 0 and a == retries),
+            program_fp=program_fp,
         )
         t0 = time.perf_counter()
         with observe.span("jax.execute", category="jax") as esp:
@@ -1000,6 +1230,12 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
             "jax.pipeline.group", category="jax", group=gi,
             n_outputs=len(sub),
         ):
+            program_fp = (
+                _registry_program_fp(fake_list, idxs, out_shardings,
+                                     param_dtype, cast_mask)
+                if eff_cfg.registry_dir else None
+            )
+
             def _attempt(a):
                 fn = build_init_fn(sub)
                 if param_dtype is not None:
@@ -1014,6 +1250,7 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                     fn, key, osh, label=gi, fault_plan=fault_plan,
                     deadline=deadline,
                     bypass_cache=(retries > 0 and a == retries),
+                    program_fp=program_fp,
                 )
 
             return _run_ladder(
@@ -1234,12 +1471,24 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
         fault_plan = chaos.active_plan()
         bins = _plan_pipeline(fake_list) if mode == "auto" else None
         key = jax.random.PRNGKey(seed)
+
+        def _whole_fp():
+            # The whole-model program's registry fingerprint — computed
+            # only when a registry is configured (a full graph walk).
+            if not config.get().registry_dir:
+                return None
+            return _registry_program_fp(
+                fake_list, list(range(len(fake_list))), out_shardings,
+                param_dtype, cast_mask,
+            )
+
         if bins is None:
             init_fn = _cast_outputs(
                 build_init_fn(fake_list), param_dtype, cast_mask
             )
             values = _run_init(init_fn, key, out_shardings,
-                               fault_plan=fault_plan)
+                               fault_plan=fault_plan,
+                               program_fp=_whole_fp())
         else:
             try:
                 values = _run_init_pipelined(
@@ -1263,7 +1512,8 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
                 )
                 try:
                     values = _run_init(init_fn, key, out_shardings,
-                                       fault_plan=fault_plan)
+                                       fault_plan=fault_plan,
+                                       program_fp=_whole_fp())
                 except MaterializationError as e2:
                     # The whole ladder is spent; surface the pipelined
                     # run's partial progress so a rerun can resume it.
