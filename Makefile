@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -47,11 +47,19 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke
+chaos-test: registry-smoke serve-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
-	    tests/test_registry.py \
+	    tests/test_registry.py tests/test_serve.py \
 	    -q -p no:cacheprovider
+
+# Serving smoke (docs/serving.md): decode-program warm into a shared
+# artifact registry, then a fresh-process replica bring-up with an
+# EMPTY local cache that must perform zero local compiles and serve a
+# scripted request storm whose outputs equal the unbatched oracle.
+# CPU, bounded; part of `make chaos-test`.
+serve-smoke:
+	timeout -k 10 420 bash scripts/serve_smoke.sh
 
 # Pod-scale registry smoke (docs/registry.md): a 2-process sharded warm
 # against a shared artifact registry — disjoint compile shards verified

@@ -1059,6 +1059,140 @@ def _publish_pipeline_phase(out: dict, times: dict, rep_stats: dict) -> None:
         out[f"cold_{mode}_all_s"] = [round(t, 2) for t in times[mode]]
 
 
+def phase_serving() -> dict:
+    """Inference-serving phase (docs/serving.md): decode tokens/s
+    through the continuous-batching engine, and time-to-first-token for
+    a COLD replica bring-up (every program XLA-compiled) vs a
+    REGISTRY-WARM one (every program fetched from a pre-published
+    artifact registry into a fresh local cache) — the autoscaling story
+    the serving runtime exists for, measured.
+
+    Gates (raise ⇒ CI fails, not just a slow number): every request's
+    tokens equal the unbatched no-cache oracle, and the warm bring-up
+    performs ZERO local compiles."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+    jax = _virtual_cpu_init(1)
+    import numpy as np
+
+    import jax.numpy as jnp
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import observe
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        Request, ServeConfig, oracle_generate, spin_up_replica,
+        warm_serving,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=3, n_heads=8, n_kv_heads=4,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32,
+    )
+    scfg = ServeConfig(max_batch=4, page_size=8, n_pages=48,
+                       max_pages_per_seq=4, prefill_buckets=(8, 16))
+
+    def mix():
+        rng = np.random.RandomState(0)
+        return [
+            Request(f"r{i}", [int(t) for t in
+                              rng.randint(0, cfg.vocab_size,
+                                          size=2 + int(rng.randint(12)))],
+                    max_new_tokens=8 + int(rng.randint(8)),
+                    arrival_step=i // 2)
+            for i in range(8)
+        ]
+
+    jax.devices()
+    out = {"model_d": cfg.d_model, "n_layers": cfg.n_layers,
+           "max_batch": scfg.max_batch, "page_size": scfg.page_size}
+    reg = tempfile.mkdtemp(prefix="tdx_serve_bench_reg_")
+    caches = []
+
+    def fresh_cache(tag):
+        d = tempfile.mkdtemp(prefix=f"tdx_serve_bench_{tag}_")
+        caches.append(d)
+        return d
+
+    first_token_t = {}
+
+    def on_token(rid, _tok):
+        first_token_t.setdefault(rid, time.perf_counter())
+
+    try:
+        # COLD: empty cache, no registry — bring-up pays every compile.
+        mat._reset_cache_binding()
+        with tdx_config.override(cache_dir=fresh_cache("cold")):
+            t0 = time.perf_counter()
+            eng = spin_up_replica(cfg, family="llama", serve_cfg=scfg,
+                                  on_token=on_token)
+            out["bring_up_cold_s"] = round(time.perf_counter() - t0, 3)
+            probe = Request("probe", [7, 3, 11], max_new_tokens=2)
+            eng.run([probe])
+            out["ttft_cold_s"] = round(first_token_t["probe"] - t0, 3)
+            # Throughput: a scripted storm through the warm engine.
+            reqs = mix()
+            t0 = time.perf_counter()
+            results = eng.run(reqs)
+            dt = time.perf_counter() - t0
+            n_tok = sum(len(results[r.rid]) for r in reqs)
+            out["decode_tokens_per_s"] = round(n_tok / dt, 2)
+            out["storm_requests"] = len(reqs)
+            out["storm_tokens"] = n_tok
+            for r in reqs:
+                want, _ = oracle_generate("llama", cfg, eng.params,
+                                          r.tokens, r.max_new_tokens)
+                if results[r.rid] != want:
+                    raise RuntimeError(
+                        f"serving output diverged from the unbatched "
+                        f"oracle on {r.rid}"
+                    )
+        out["oracle_equal"] = True
+
+        # WARM: publish the program set, then bring up from a FRESH
+        # local cache through the registry.
+        mat._reset_cache_binding()
+        warm_serving("llama", cfg, fresh_cache("pub"), registry_dir=reg,
+                     serve_cfg=scfg)
+        mat._reset_cache_binding()
+        observe.enable(True)
+        base = {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+        with tdx_config.override(cache_dir=fresh_cache("warm"),
+                                 registry_dir=reg):
+            first_token_t.clear()
+            t0 = time.perf_counter()
+            eng = spin_up_replica(cfg, family="llama", serve_cfg=scfg,
+                                  on_token=on_token)
+            out["bring_up_warm_s"] = round(time.perf_counter() - t0, 3)
+            probe = Request("probe", [7, 3, 11], max_new_tokens=2)
+            eng.run([probe])
+            out["ttft_warm_s"] = round(first_token_t["probe"] - t0, 3)
+        snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+        miss = (snap.get("tdx.jax.compile_cache_miss", 0)
+                - base.get("tdx.jax.compile_cache_miss", 0))
+        out["warm_local_compiles"] = int(miss)
+        out["warm_bring_up_outcomes"] = eng.bring_up_outcomes
+        if miss:
+            raise RuntimeError(
+                f"registry-warm bring-up paid {int(miss)} local compiles"
+            )
+        out["ttft_warm_speedup"] = round(
+            out["ttft_cold_s"] / out["ttft_warm_s"], 3
+        )
+    finally:
+        observe.enable(None)
+        mat._reset_cache_binding()
+        shutil.rmtree(reg, ignore_errors=True)
+        for d in caches:
+            shutil.rmtree(d, ignore_errors=True)
+    out["backend"] = "cpu"
+    return out
+
+
 def phase_pp_bubble() -> dict:
     """STATIC schedule analysis (no hardware, no wall clocks — tick
     counts and buffer sizes are properties of the schedule tables, so
@@ -1198,6 +1332,7 @@ PHASES = {
     "flash_bias": phase_flash_bias,
     "pp_bubble": phase_pp_bubble,
     "schedule_measured": phase_schedule_measured,
+    "serving": phase_serving,
     "train_mfu": phase_train_mfu,
     "materialize_pipeline": phase_materialize_pipeline,
 }
@@ -1741,6 +1876,16 @@ def main() -> None:
         out["schedule_measured"] = sm.get("schedule_measured")
     else:
         out["schedule_measured_error"] = sm["error"][-160:]
+
+    sv = _run_phase("serving", timeout=600.0)
+    sv.pop("_backend", None)  # forced-CPU serving A/B: cpu by design
+    if "error" not in sv:
+        out["serving"] = sv
+        # Promoted headline key: cold-compile vs registry-warm TTFT.
+        if sv.get("ttft_warm_speedup") is not None:
+            out["serving_ttft_warm_speedup"] = sv["ttft_warm_speedup"]
+    else:
+        out["serving_error"] = sv["error"][-160:]
 
     if not fallback:
         for name in ("flash", "flash_bwd", "flash_bias"):

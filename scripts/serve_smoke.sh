@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Serving smoke (make serve-smoke, docs/serving.md): warm a replica
+# shape's WHOLE serving program set (init + prefill buckets + decode)
+# into a shared artifact registry via `tools/warm_cache.py --decode`,
+# then spin up a replica in a FRESH process with an EMPTY local
+# TDX_CACHE_DIR — bring-up must perform ZERO local compiles (every
+# program a registry-fed cache hit) — and serve a scripted mixed
+# prefill/decode request storm whose per-request outputs must equal the
+# unbatched no-cache oracle (tokens exactly, final logits to tolerance).
+# CPU-only, bounded; the in-process equivalents live in tests/test_serve.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TDX_CACHE_MIN_COMPILE_S=0
+
+TMP=$(mktemp -d /tmp/tdx_serve_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+REG="$TMP/registry"
+
+echo "== decode-program warm: init + prefill buckets + decode published =="
+python tools/warm_cache.py --decode --model tiny --cache-dir "$TMP/warm" \
+    --registry-dir "$REG" --serve-batch 2 --page-size 8 --pages 32 \
+    --max-pages-per-seq 4 --prefill-buckets 8,16 \
+    > "$TMP/warm.json" 2> "$TMP/warm.log"
+grep '^warm:' "$TMP/warm.log" | sed 's/^/  /'
+python - "$TMP/warm.json" <<'EOF'
+import json, sys
+rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert not rep["unwarmed"], rep["unwarmed"]
+names = {r["program"] for r in rep["program_reports"]}
+assert names == {"init", "prefill-8", "prefill-16", "decode"}, names
+print(f"  OK: {len(names)} programs published")
+EOF
+
+echo "== fresh-process replica: zero local compiles, storm == oracle =="
+TDX_CACHE_DIR="$TMP/fresh" TDX_REGISTRY_DIR="$REG" python - <<'EOF'
+import numpy as np
+from torchdistx_tpu import observe
+from torchdistx_tpu.serve import (
+    Request, ServeConfig, oracle_generate, spin_up_replica,
+)
+
+observe.enable(True)
+scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                   max_pages_per_seq=4, prefill_buckets=(8, 16))
+eng = spin_up_replica("tiny", serve_cfg=scfg)
+
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+miss = snap.get("tdx.jax.compile_cache_miss", 0)
+hit = snap.get("tdx.jax.compile_cache_hit", 0)
+assert miss == 0, f"bring-up paid {miss} local compiles: {eng.bring_up_outcomes}"
+assert hit >= 4, (hit, eng.bring_up_outcomes)
+assert set(eng.bring_up_outcomes.values()) == {"hit"}, eng.bring_up_outcomes
+print(f"  bring-up: {int(hit)} programs, 0 local compiles "
+      f"({eng.bring_up_seconds:.2f}s)")
+
+# Scripted mixed prefill/decode storm: more requests than lanes,
+# staggered arrivals, mixed prompt lengths and budgets.
+rng = np.random.RandomState(7)
+reqs = [
+    Request(f"r{i}",
+            [int(t) for t in rng.randint(0, 256, size=1 + int(rng.randint(12)))],
+            max_new_tokens=2 + int(rng.randint(6)),
+            arrival_step=i // 2)
+    for i in range(6)
+]
+out = eng.run(reqs)
+for r in reqs:
+    want, want_logits = oracle_generate(
+        eng.family, eng.cfg, eng.params, r.tokens, r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, out[r.rid], want)
+    np.testing.assert_allclose(eng.final_logits[r.rid], want_logits,
+                               atol=1e-4)
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+assert snap.get("tdx.serve.requests_completed", 0) >= len(reqs)
+assert eng.kv.pages_in_use == 0  # every retirement freed its pages
+print(f"  OK: {len(reqs)} requests complete, all == unbatched oracle, "
+      f"{int(snap['tdx.serve.decode_steps'])} decode steps")
+EOF
+
+echo "serve-smoke OK"

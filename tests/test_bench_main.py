@@ -64,6 +64,12 @@ _HOST_PHASES = {
         "workers": 4, "overlap": 3.8, "bitwise_equal": True,
         "pipeline_speedup": 1.408, "backend": "cpu", "_backend": "cpu"},
     "pp_bubble": {"schedule_analysis": {"pp4_v2_m8": {"interleaved_ticks": 26}}},
+    "serving": {
+        "bring_up_cold_s": 4.1, "ttft_cold_s": 4.13,
+        "bring_up_warm_s": 0.77, "ttft_warm_s": 0.77,
+        "ttft_warm_speedup": 5.34, "decode_tokens_per_s": 1360.0,
+        "warm_local_compiles": 0, "oracle_equal": True,
+        "backend": "cpu", "_backend": "cpu"},
     "schedule_measured": {"schedule_measured": {
         "gpipe_step_ms": 1769.0, "flat_1f1b_step_ms": 2509.0,
         "interleaved_step_ms": 2078.0, "interleaved_vs_flat_measured": 1.208,

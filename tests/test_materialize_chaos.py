@@ -153,7 +153,12 @@ class TestWatchdog:
         # The ladder waited out the 1 s deadline (+ retry), not the 30 s
         # injected hang.
         assert wall < 20.0
-        assert _counter("tdx.jax.compile_watchdog_kills") == before + 1
+        # >= rather than ==: on the 1-core CI box a legitimately slow
+        # RETRY compile can also trip the 1 s deadline and count a
+        # second kill (observed flaking at full-suite load); the
+        # contract under test is "the hang was abandoned, counted, and
+        # the run recovered", not "exactly one stage was ever slow".
+        assert _counter("tdx.jax.compile_watchdog_kills") >= before + 1
         _assert_bitwise(params, baseline)
         _no_leaked_watchdog_threads()
 

@@ -1,0 +1,215 @@
+"""tools/registry_ctl.py tests (ISSUE 7 satellite): ls/verify/gc/stats
+over a registry directory, with the age+atime GC sweep and the
+verify-quarantine path agreeing with the store's own verification
+rule."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import registry_ctl  # noqa: E402
+from torchdistx_tpu.registry import ArtifactRegistry  # noqa: E402
+
+
+def _publish(root, key, payload=b"x" * 64, name="deadbeef-cache", meta=None):
+    reg = ArtifactRegistry(str(root))
+    assert reg.publish(key, {name: payload}, meta or {"program_fp": "fp-" + key})
+    return reg
+
+
+def _run(capsys, *argv):
+    rc = registry_ctl.main(list(argv))
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def _age(root, key, days, *, atime_days=None):
+    """Back-date an entry's publish stamp and file times."""
+    edir = os.path.join(str(root), key)
+    t = time.time() - days * 86400
+    meta_path = os.path.join(edir, "meta.json")
+    with open(meta_path) as f:
+        doc = json.load(f)
+    doc["created"] = t
+    with open(meta_path, "w") as f:
+        json.dump(doc, f)
+    at = time.time() - (atime_days if atime_days is not None else days) * 86400
+    for name in os.listdir(edir):
+        os.utime(os.path.join(edir, name), (at, t))
+
+
+def test_ls_and_stats(tmp_path, capsys):
+    _publish(tmp_path, "a" * 40)
+    _publish(tmp_path, "b" * 40, payload=b"y" * 128)
+    rc, out = _run(capsys, "ls", str(tmp_path))
+    assert rc == 0 and out["n"] == 2
+    by_key = {r["key"]: r for r in out["entries"]}
+    assert by_key["b" * 40]["bytes"] == 128
+    assert by_key["a" * 40]["program_fp"] == "fp-" + "a" * 40
+    assert all(r["complete"] for r in out["entries"])
+
+    rc, st = _run(capsys, "stats", str(tmp_path))
+    assert rc == 0
+    assert st["entries"] == 2 and st["bytes"] == 192
+    assert st["corrupt"] == 0 and st["incomplete"] == 0
+
+
+def test_verify_flags_and_quarantines_corruption(tmp_path, capsys):
+    _publish(tmp_path, "a" * 40)
+    _publish(tmp_path, "b" * 40)
+    victim = tmp_path / ("b" * 40) / "deadbeef-cache"
+    victim.write_bytes(b"z" * 64)  # same size, wrong CRC
+
+    rc, out = _run(capsys, "verify", str(tmp_path))
+    assert rc == 1
+    assert out["checked"] == 2 and out["failed"] == 1
+    assert out["bad"][0]["key"] == "b" * 40
+    assert out["quarantined"] == 0  # report-only without the flag
+
+    rc, out = _run(capsys, "verify", str(tmp_path), "--quarantine")
+    assert rc == 1 and out["quarantined"] == 1
+    assert (tmp_path / ("b" * 40 + ".corrupt")).is_dir()
+    # The survivor verifies clean now.
+    rc, out = _run(capsys, "verify", str(tmp_path))
+    assert rc == 0 and out["checked"] == 1 and out["failed"] == 0
+
+
+def test_verify_matches_store_fetch_verdict(tmp_path, capsys):
+    """ctl's verification rule == the store's: what ctl flags, a fetch
+    would quarantine; what ctl passes, a fetch serves."""
+    reg = _publish(tmp_path, "a" * 40)
+    assert reg.fetch("a" * 40) is not None
+    rc, _ = _run(capsys, "verify", str(tmp_path))
+    assert rc == 0
+    (tmp_path / ("a" * 40) / "deadbeef-cache").write_bytes(b"q")
+    rc, _ = _run(capsys, "verify", str(tmp_path))
+    assert rc == 1
+    assert reg.fetch("a" * 40) is None  # quarantined by the fetch too
+
+
+def test_gc_age_and_atime_sweep(tmp_path, capsys):
+    """Old AND idle entries are swept; old-but-recently-read and fresh
+    entries survive — age alone never evicts a hot artifact."""
+    _publish(tmp_path, "a" * 40)                      # fresh
+    _publish(tmp_path, "b" * 40)                      # old + idle -> dead
+    _publish(tmp_path, "c" * 40)                      # old but hot -> kept
+    _age(tmp_path, "b" * 40, days=40)
+    _age(tmp_path, "c" * 40, days=40, atime_days=0.5)
+
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30",
+                   "--min-atime-days", "7", "--dry-run")
+    assert rc == 0 and out["dry_run"] is True
+    assert out["removed"] == ["b" * 40]
+    assert (tmp_path / ("b" * 40)).is_dir()  # dry run touched nothing
+
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30",
+                   "--min-atime-days", "7")
+    assert out["swept"] == 1 and out["kept"] == 2
+    assert not (tmp_path / ("b" * 40)).is_dir()
+    assert (tmp_path / ("a" * 40)).is_dir()
+    assert (tmp_path / ("c" * 40)).is_dir()
+
+
+def test_gc_sweeps_corrupt_and_stale_tmp(tmp_path, capsys):
+    _publish(tmp_path, "a" * 40)
+    # A quarantined entry and a torn publish from a dead publisher.
+    corrupt = tmp_path / ("d" * 40 + ".corrupt")
+    corrupt.mkdir()
+    (corrupt / "junk").write_bytes(b"j")
+    stale_tmp = tmp_path / ".tmp-pub-dead-1-2"
+    stale_tmp.mkdir()
+    old = time.time() - 2 * 86400
+    os.utime(stale_tmp, (old, old))
+    fresh_tmp = tmp_path / ".tmp-pub-live-3-4"
+    fresh_tmp.mkdir()
+
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30")
+    assert rc == 0
+    assert out["corrupt_removed"] == 1 and out["tmp_removed"] == 1
+    assert not corrupt.is_dir()
+    assert not stale_tmp.is_dir()
+    assert fresh_tmp.is_dir()  # a live publisher may still own it
+
+    # --keep-corrupt preserves forensics.
+    corrupt.mkdir()
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30",
+                   "--keep-corrupt")
+    assert out["corrupt_removed"] == 0 and corrupt.is_dir()
+
+
+def test_verify_does_not_defeat_gc_idle_test(tmp_path, capsys):
+    """A cron'd verify full-reads payloads; it must restore atime so
+    old-and-idle entries still gc — verification is not 'use'."""
+    _publish(tmp_path, "a" * 40)
+    _age(tmp_path, "a" * 40, days=40)
+    rc, _ = _run(capsys, "verify", str(tmp_path))
+    assert rc == 0
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30",
+                   "--min-atime-days", "7")
+    assert out["swept"] == 1, out
+
+
+def test_gc_keeps_entry_on_transient_manifest_error(tmp_path, capsys):
+    """A manifest that EXISTS but cannot be read this cycle (stale NFS
+    handle, EIO) must never be swept as a torn publish — only a
+    genuinely absent meta.json qualifies."""
+    edir = tmp_path / ("f" * 40)
+    edir.mkdir()
+    (edir / "payload-cache").write_bytes(b"p")
+    # meta.json exists but open() raises (a directory): the transient-
+    # error shape, as seen by _entries.
+    (edir / "meta.json").mkdir()
+    old = time.time() - 40 * 86400
+    for p in (edir, edir / "payload-cache", edir / "meta.json"):
+        os.utime(p, (old, old))
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30",
+                   "--min-atime-days", "7")
+    assert rc == 0
+    assert out["swept"] == 0 and out["kept"] == 1
+    assert edir.is_dir()
+
+
+def test_verify_never_quarantines_on_transient_manifest_error(tmp_path,
+                                                              capsys):
+    """verify --quarantine must not destroy a live entry whose manifest
+    merely failed to READ this cycle (one NFS hiccup + cron'd verify +
+    gc of .corrupt dirs would otherwise permanently delete a published
+    artifact); a manifest that parses as garbage IS quarantined."""
+    edir = tmp_path / ("f" * 40)
+    edir.mkdir()
+    (edir / "payload-cache").write_bytes(b"p")
+    (edir / "meta.json").mkdir()  # exists, open() raises → transient shape
+    rc, out = _run(capsys, "verify", str(tmp_path), "--quarantine")
+    assert rc == 0  # nothing FAILED — one entry skipped
+    assert out["skipped_io"] == 1 and out["failed"] == 0
+    assert edir.is_dir() and not (tmp_path / ("f" * 40 + ".corrupt")).exists()
+
+    bdir = tmp_path / ("g" * 40)
+    bdir.mkdir()
+    (bdir / "payload-cache").write_bytes(b"p")
+    (bdir / "meta.json").write_text("{not json")  # real corruption
+    rc, out = _run(capsys, "verify", str(tmp_path), "--quarantine")
+    assert rc == 1 and out["failed"] == 1 and out["quarantined"] == 1
+    assert (tmp_path / ("g" * 40 + ".corrupt")).is_dir()
+
+
+def test_gc_sweeps_torn_incomplete_entries(tmp_path, capsys):
+    """A manifest-less entry dir older than the tmp horizon is a torn
+    publish that never renamed — swept; stats counts it meanwhile."""
+    _publish(tmp_path, "a" * 40)
+    torn = tmp_path / ("e" * 40)
+    torn.mkdir()
+    (torn / "payload").write_bytes(b"p")
+    old = time.time() - 2 * 86400
+    for p in (torn, torn / "payload"):
+        os.utime(p, (old, old))
+    rc, st = _run(capsys, "stats", str(tmp_path))
+    assert st["incomplete"] == 1
+    rc, out = _run(capsys, "gc", str(tmp_path), "--max-age-days", "30")
+    assert out["swept"] == 1
+    assert not torn.is_dir()

@@ -45,6 +45,18 @@ trouble must only ever cost local compiles, never correctness:
     python tools/soak.py --modes registry --seconds 300 \\
         --fault-plan 'registry@1=raise;registry@2=corrupt:flip'
 
+The ``serve`` mode soaks the inference-serving runtime
+(docs/serving.md): each seed spins up a randomized tiny replica,
+submits a randomized staggered request mix through the
+continuous-batching engine under an injected ``serve`` fault plan
+(replica faults mid-batch, slow steps) and a deliberately tight page
+pool (so preemption-and-requeue fires for real), and asserts every
+request's generated tokens equal the unbatched no-cache oracle —
+batching, paging, preemption, and faults must never change a token:
+
+    python tools/soak.py --modes serve --seconds 300 \\
+        --fault-plan 'serve@2=raise;serve@5=slow:0.1'
+
 Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
 exception) and the exit code is non-zero if any occurred.
 """
@@ -63,7 +75,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
          "geom", "geom_single", "geom_bridge", "elastic", "materialize",
-         "registry")
+         "registry", "serve")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -349,6 +361,92 @@ def _registry_oracle(seed: int, plan_text: "str | None"):
     return None
 
 
+def _serve_oracle(seed: int, plan_text: "str | None"):
+    """One serving-correctness run: a randomized tiny replica serves a
+    randomized staggered request mix through the continuous-batching
+    engine — under a ``serve`` fault plan and a page pool tight enough
+    to force preemption — and every request's tokens must equal the
+    unbatched oracle's."""
+    import random
+
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        Request,
+        ServeConfig,
+        ServeEngine,
+        oracle_generate,
+        serve_program_specs,
+    )
+    from torchdistx_tpu.serve.programs import compile_serving_program
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = random.Random(seed)
+    cfg = TransformerConfig(
+        vocab_size=rng.choice([96, 128]),
+        d_model=rng.choice([32, 48]),
+        n_layers=rng.randrange(1, 3),
+        n_heads=4,
+        n_kv_heads=rng.choice([2, 4]),
+        d_ff=64,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    scfg = ServeConfig(
+        max_batch=rng.randrange(2, 4),
+        page_size=rng.choice([4, 8]),
+        n_pages=rng.randrange(8, 14),  # deliberately tight
+        max_pages_per_seq=4,
+        prefill_buckets=(8,),
+    )
+    resolved = scfg.resolve(cfg)
+    family = "llama"
+    specs = serve_program_specs(family, cfg, scfg, seed=seed % 7)
+    init = specs[0]
+    compiled, _ = compile_serving_program(init)
+    params = jax.tree.unflatten(init.treedef, list(compiled()))
+
+    n_req = rng.randrange(3, 6)
+    reqs = []
+    for i in range(n_req):
+        prompt = [rng.randrange(cfg.vocab_size) for _ in
+                  range(rng.randrange(1, 8))]
+        budget = rng.randrange(1, 1 + min(
+            8, resolved.max_context - len(prompt)))
+        reqs.append(Request(
+            f"r{i}", prompt, max_new_tokens=budget,
+            arrival_step=rng.randrange(0, 4),
+        ))
+
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        entries = []
+        for _ in range(rng.randrange(1, 3)):
+            kind = rng.choice(["raise", "slow"])
+            arg = ":0.05" if kind == "slow" else ""
+            entries.append(f"serve@{rng.randrange(1, 6)}={kind}{arg}")
+        plan = chaos.parse_plan(";".join(entries))
+
+    chaos.install(plan)
+    try:
+        eng = ServeEngine(family, cfg, params, serve_cfg=scfg,
+                          seed=seed % 7)
+        out = eng.run(reqs)
+    finally:
+        chaos.clear()
+    for r in reqs:
+        want, _ = oracle_generate(family, cfg, params, r.tokens,
+                                  r.max_new_tokens, r.eos_id)
+        if out.get(r.rid) != want:
+            return ("mismatch",
+                    f"{r.rid}: engine={out.get(r.rid)} oracle={want} "
+                    f"plan={plan!r}")
+    return None
+
+
 def _run_seed(mode: str, seed: int):
     """Run one oracle; returns None on pass/skip, (kind, message) else."""
     import random
@@ -404,6 +502,10 @@ def _run_seed(mode: str, seed: int):
                 return r
         elif mode == "registry":
             r = _registry_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
+        elif mode == "serve":
+            r = _serve_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
         elif mode == "serialize":

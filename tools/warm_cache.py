@@ -32,6 +32,17 @@ Every program reports its own outcome (``published`` / ``compiled`` /
 followed by a summary JSON line; the exit status is non-zero if ANY
 program ended unwarmed.
 
+**Serving-program warm** (``--decode``, docs/serving.md): warm a
+replica's WHOLE bring-up program set — the deferred-init parameter
+program, every prefill bucket, and the continuous-batching decode
+program — so ``serve.spin_up_replica`` of the same shape performs zero
+local compiles end to end.  ``--model`` then names a model-zoo preset
+(``tiny``, ``tiny-gpt2``, ``gpt2-125m``, ``llama3-8b``, ...) and the
+serve shape knobs (``--serve-batch`` / ``--page-size`` / ``--pages`` /
+``--max-pages-per-seq`` / ``--prefill-buckets``) must match the
+consumer's ``ServeConfig`` — they are part of the programs' registry
+identity by design.
+
 Usage::
 
     python tools/warm_cache.py --model gpt2 --cache-dir .jax_cache
@@ -40,6 +51,8 @@ Usage::
     python tools/warm_cache.py --module mypkg.models:build --cache-dir d
     python tools/warm_cache.py --model gpt2 --cache-dir .jax_cache \\
         --registry-dir /nfs/tdx_registry --hosts 4 --host-id 2
+    python tools/warm_cache.py --decode --model tiny --cache-dir d \\
+        --registry-dir /nfs/tdx_registry --serve-batch 4 --page-size 16
 
 Cache-key caveats: entries are keyed on backend, topology, and compile
 options — warm on the platform (and device count) the consumer will see.
@@ -100,6 +113,25 @@ def _parse_args(argv):
                         "before compiling it locally (work stealing)")
     p.add_argument("--poll", type=float, default=0.5,
                    help="registry polling interval during the fill phase")
+    p.add_argument("--decode", action="store_true",
+                   help="warm the SERVING program set (init + prefill "
+                        "buckets + decode) for a model-zoo preset named "
+                        "by --model (docs/serving.md)")
+    p.add_argument("--serve-batch", type=int, default=4,
+                   help="--decode: decode batch lanes (ServeConfig."
+                        "max_batch)")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="--decode: KV page size in tokens")
+    p.add_argument("--pages", type=int, default=64,
+                   help="--decode: KV pool pages (incl. the null page)")
+    p.add_argument("--max-pages-per-seq", type=int, default=0,
+                   help="--decode: page-table width (0 = fit max_seq_len)")
+    p.add_argument("--prefill-buckets", default=None,
+                   help="--decode: comma-separated prompt buckets "
+                        "(default: powers of two up to the context cap)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="--decode: replica init seed (part of the init "
+                        "program's identity)")
     return p.parse_args(argv)
 
 
@@ -164,22 +196,12 @@ def _parse_mesh(spec):
     return axes
 
 
-def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
-         skip_whole=False, skip_groups=False, registry_dir=None,
-         hosts=1, host_id=0, steal_after_s=120.0, poll_s=0.5) -> dict:
-    """Compile a module factory's init programs into ``cache_dir`` (and,
-    when ``registry_dir`` is set, exchange them through the shared
-    artifact registry — sharded across ``hosts`` by
-    :func:`torchdistx_tpu.registry.warm_sharded`); returns a summary
-    dict with per-program outcome reports.  Importable (the tests drive
-    it in-process); ``main`` is the CLI shell around it."""
-    from torchdistx_tpu.registry import warm_sharded
-
-    # Fail fast on an unusable cache dir: jax itself degrades cache-WRITE
-    # errors to warnings, so without this probe the tool would burn the
-    # full compile budget and then claim success while having warmed
-    # nothing.  (A permissions probe via os.access lies under root, so
-    # actually write.)
+def _probe_cache_dir(cache_dir: str) -> None:
+    """Fail fast on an unusable cache dir: jax itself degrades cache-WRITE
+    errors to warnings, so without this probe the tool would burn the
+    full compile budget and then claim success while having warmed
+    nothing.  (A permissions probe via os.access lies under root, so
+    actually write.)"""
     probe = os.path.join(cache_dir, f".tdx_warm_probe_{os.getpid()}")
     try:
         os.makedirs(cache_dir, exist_ok=True)
@@ -191,16 +213,40 @@ def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
             f"cache dir {cache_dir!r} is not writable ({e}); nothing warmed"
         ) from e
 
-    # The tool exists to persist: never let jax's 0.1 s min-compile-time
-    # threshold silently skip writing the fast-compiling group programs
-    # this run claims to have warmed (explicit env wins; the prior value
-    # is restored on exit — warm() is documented as importable, and an
-    # in-process caller must keep the documented persist boundary).
-    # Publishing rides on the same boundary: only persisted entries can
-    # be published to the registry.
-    prior_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
-    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
-    try:
+
+class _persist_everything:
+    """The tool exists to persist: never let jax's 0.1 s min-compile-time
+    threshold silently skip writing the fast-compiling programs this run
+    claims to have warmed (explicit env wins; the prior value is
+    restored on exit — the warm entry points are documented as
+    importable, and an in-process caller must keep the documented
+    persist boundary).  Publishing rides on the same boundary: only
+    persisted entries can be published to the registry."""
+
+    def __enter__(self):
+        self._prior = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+        os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+
+    def __exit__(self, *exc):
+        if self._prior is None:
+            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["TDX_CACHE_MIN_COMPILE_S"] = self._prior
+
+
+def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
+         skip_whole=False, skip_groups=False, registry_dir=None,
+         hosts=1, host_id=0, steal_after_s=120.0, poll_s=0.5) -> dict:
+    """Compile a module factory's init programs into ``cache_dir`` (and,
+    when ``registry_dir`` is set, exchange them through the shared
+    artifact registry — sharded across ``hosts`` by
+    :func:`torchdistx_tpu.registry.warm_sharded`); returns a summary
+    dict with per-program outcome reports.  Importable (the tests drive
+    it in-process); ``main`` is the CLI shell around it."""
+    from torchdistx_tpu.registry import warm_sharded
+
+    _probe_cache_dir(cache_dir)
+    with _persist_everything():
         return warm_sharded(
             factory, cache_dir, registry_dir=registry_dir,
             hosts=hosts, host_id=host_id, mesh=mesh, plan=plan,
@@ -208,11 +254,32 @@ def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
             skip_groups=skip_groups, steal_after_s=steal_after_s,
             poll_s=poll_s,
         )
-    finally:
-        if prior_min is None:
-            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
-        else:
-            os.environ["TDX_CACHE_MIN_COMPILE_S"] = prior_min
+
+
+def warm_decode(model_name, cache_dir, *, registry_dir=None, serve_cfg=None,
+                seed=0, param_dtype=None, mesh=None, plan=None) -> dict:
+    """Warm the SERVING program set of a model-zoo preset — the
+    deferred-init parameter program, every prefill bucket, and the
+    decode program — via :func:`torchdistx_tpu.serve.warm_serving`, so a
+    later ``spin_up_replica`` of the same shape is all-hit end to end."""
+    from torchdistx_tpu.models import PRESETS, TransformerConfig
+    from torchdistx_tpu.serve import warm_serving
+    from torchdistx_tpu.serve.programs import model_family
+
+    cfg = PRESETS.get(model_name)
+    if not isinstance(cfg, TransformerConfig) or cfg.moe is not None:
+        raise SystemExit(
+            f"--decode needs a DENSE decoder-LM zoo preset for --model; "
+            f"{model_name!r} is not one (choose from "
+            f"{sorted(k for k, v in PRESETS.items() if isinstance(v, TransformerConfig) and v.moe is None)})"
+        )
+    _probe_cache_dir(cache_dir)
+    with _persist_everything():
+        return warm_serving(
+            model_family(model_name), cfg, cache_dir,
+            registry_dir=registry_dir, serve_cfg=serve_cfg, seed=seed,
+            param_dtype=param_dtype, mesh=mesh, plan=plan,
+        )
 
 
 def main(argv=None) -> None:
@@ -241,13 +308,41 @@ def main(argv=None) -> None:
         param_dtype = getattr(jnp, args.param_dtype)
 
     os.makedirs(args.cache_dir, exist_ok=True)
-    summary = warm(
-        _model_factory(args), args.cache_dir, mesh=mesh, plan=plan,
-        param_dtype=param_dtype, skip_whole=args.skip_whole,
-        skip_groups=args.skip_groups, registry_dir=args.registry_dir,
-        hosts=args.hosts, host_id=args.host_id,
-        steal_after_s=args.steal_after, poll_s=args.poll,
-    )
+    if args.decode:
+        if args.model is None:
+            raise SystemExit("--decode requires --model <zoo preset>")
+        if args.hosts > 1:
+            raise SystemExit(
+                "--decode warms a single replica shape; sharded "
+                "multi-host warming applies to the init-program sets "
+                "(drop --hosts)"
+            )
+        from torchdistx_tpu.serve import ServeConfig
+
+        buckets = ()
+        if args.prefill_buckets:
+            buckets = tuple(
+                int(b) for b in args.prefill_buckets.split(",") if b.strip()
+            )
+        serve_cfg = ServeConfig(
+            max_batch=args.serve_batch, page_size=args.page_size,
+            n_pages=args.pages,
+            max_pages_per_seq=args.max_pages_per_seq or None,
+            prefill_buckets=buckets,
+        )
+        summary = warm_decode(
+            args.model, args.cache_dir, registry_dir=args.registry_dir,
+            serve_cfg=serve_cfg, seed=args.seed, param_dtype=param_dtype,
+            mesh=mesh, plan=plan,
+        )
+    else:
+        summary = warm(
+            _model_factory(args), args.cache_dir, mesh=mesh, plan=plan,
+            param_dtype=param_dtype, skip_whole=args.skip_whole,
+            skip_groups=args.skip_groups, registry_dir=args.registry_dir,
+            hosts=args.hosts, host_id=args.host_id,
+            steal_after_s=args.steal_after, poll_s=args.poll,
+        )
     for rep in summary.get("program_reports", []):
         line = (f"warm: program={rep['program']} outputs={rep['outputs']} "
                 f"outcome={rep['outcome']}")

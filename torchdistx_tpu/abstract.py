@@ -214,7 +214,7 @@ def materialize(
     return jax.tree.unflatten(treedef, list(values))
 
 
-def build_materialize_fn(
+def materialize_parts(
     tree: Any,
     *,
     mesh: Optional[Mesh] = None,
@@ -222,11 +222,13 @@ def build_materialize_fn(
     specs: Optional[Any] = None,
     param_dtype=None,
 ):
-    """The program-construction half of :func:`materialize`: returns
-    ``(jitted_fn, treedef)`` WITHOUT executing.  A login host uses this
-    to ``.lower()`` or ``jax.export`` the complete sharded init program
-    for a pod slice it does not have (the JAX-frontend counterpart of
-    jax_bridge.export's torch-module path)."""
+    """The raw pieces of a :func:`materialize` program, un-jitted:
+    ``(run_fn, out_shardings, treedef)`` where ``run_fn()`` computes the
+    selected leaves.  Callers that need to own the compile — the serving
+    runtime routes replica param-init through
+    ``jax_bridge.materialize._compile_program`` so the artifact registry
+    and the compile-cache telemetry cover it — build on this;
+    :func:`build_materialize_fn` is the plain-jit convenience on top."""
     fakes, treedef = jax.tree.flatten(tree, is_leaf=is_fake)
     for f in fakes:
         if not is_fake(f):
@@ -247,6 +249,7 @@ def build_materialize_fn(
             for i, c in zip(wanted, cast)
         )
 
+    out_shardings = None
     if mesh is not None:
         if specs is not None:
             spec_leaves = jax.tree.leaves(
@@ -262,6 +265,26 @@ def build_materialize_fn(
             out_shardings = tuple(
                 NamedSharding(mesh, plan.spec_for(f.path, f.shape, mesh)) for f in fakes
             )
+    return run_selected, out_shardings, treedef
+
+
+def build_materialize_fn(
+    tree: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    plan: Optional[ShardingPlan] = None,
+    specs: Optional[Any] = None,
+    param_dtype=None,
+):
+    """The program-construction half of :func:`materialize`: returns
+    ``(jitted_fn, treedef)`` WITHOUT executing.  A login host uses this
+    to ``.lower()`` or ``jax.export`` the complete sharded init program
+    for a pod slice it does not have (the JAX-frontend counterpart of
+    jax_bridge.export's torch-module path)."""
+    run_selected, out_shardings, treedef = materialize_parts(
+        tree, mesh=mesh, plan=plan, specs=specs, param_dtype=param_dtype
+    )
+    if out_shardings is not None:
         fn = jax.jit(run_selected, out_shardings=out_shardings)
     else:
         fn = jax.jit(run_selected)

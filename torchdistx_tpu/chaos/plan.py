@@ -14,6 +14,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
     site  := 'step' | 'save' | 'restore'            (elastic loop)
            | 'lower' | 'compile' | 'execute' | 'cache'  (materialization)
            | 'registry'                             (artifact registry)
+           | 'serve'                                (serving engine)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
 
 Examples::
@@ -28,6 +29,9 @@ Examples::
     cache@1=corrupt:truncate     # damage the on-disk compile-cache entries
     registry@2=raise             # group 2's registry fetch/publish fails
     registry@1=corrupt:flip      # bit-rot the shared registry's artifacts
+    serve@3=raise                # replica fault at engine step 3: every
+                                 # active request is requeued and
+                                 # regenerated (recompute preemption)
 
 Each entry fires ``count`` times (default 1) and is then spent — a
 restarted step re-executes fault-free, which is what makes
@@ -41,7 +45,12 @@ the artifact registry's fetch AND publish operations (group-number
 keyed like the other materialization sites); ``corrupt`` there damages
 the shared registry's published artifacts (use kinds ``raise`` /
 ``slow`` / ``corrupt`` — both operations degrade to a local compile,
-so an injected registry fault costs savings, never correctness).
+so an injected registry fault costs savings, never correctness).  The
+``serve`` site fires at the top of every serving-engine step (1-based
+step number; kinds ``raise`` / ``slow``): a raised fault mid-batch
+requeues every active request, which greedy decode then regenerates
+identically — a replica fault costs latency, never a wrong token
+(docs/serving.md).
 """
 
 from __future__ import annotations
@@ -52,7 +61,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
-         "registry")
+         "registry", "serve")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
 
 _ENTRY_RE = re.compile(

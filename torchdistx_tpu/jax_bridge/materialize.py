@@ -724,13 +724,21 @@ def _set_run_stats(**kw) -> None:
 
 def _compile_program(init_fn, key, out_shardings, label=None, *,
                      fault_plan=None, deadline=None, bypass_cache=False,
-                     program_fp=None):
-    """jit → lower → compile ONE init program; returns
+                     program_fp=None, jit_kwargs=None,
+                     init_compiler_options=True):
+    """jit → lower → compile ONE program; returns
     ``(compiled, lower_s, compile_s, cache_outcome)``.  Safe to call from
     several threads at once — jax tracing is thread-local and the cache
     outcome is attributed through the monitoring record of whichever
     thread runs the compile (the watchdog may move it to an inner
     thread, so the record is installed there, not on the caller).
+
+    ``key`` is the program's argument: the init PRNG key for the
+    materialization engines, or a TUPLE of (abstract or concrete)
+    arguments for multi-operand programs — the serving runtime
+    (:mod:`torchdistx_tpu.serve.programs`) compiles its prefill/decode
+    programs through here so the registry, the chaos sites, the
+    watchdog, and the exact cache-outcome counters cover serving too.
 
     ``fault_plan`` pins the chaos plan for the ``lower`` / ``cache`` /
     ``compile`` / ``registry`` injection sites (group-number keyed; the
@@ -740,13 +748,20 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
     rung: a poisoned artifact must not be able to fail every attempt).
     ``program_fp`` makes the program registry-eligible: when a registry
     is configured, its artifact is fetched into the local cache before
-    the compile and the local cache entry published after."""
+    the compile and the local cache entry published after.
+    ``jit_kwargs`` pass through to ``jax.jit``; ``init_compiler_options``
+    = False compiles at the backend's default effort (steady-state
+    serving programs execute millions of times — the init programs'
+    lowest-effort codegen is exactly wrong for them; the parity-critical
+    excess-precision knob only matters for the torch-replay oracle,
+    which serving programs are not judged against)."""
     gno = label + 1 if isinstance(label, int) else 1
+    args = key if isinstance(key, tuple) else (key,)
+    kw = dict(jit_kwargs or {})
     if out_shardings is not None:
-        jitted = jax.jit(init_fn, out_shardings=out_shardings)
-    else:
-        jitted = jax.jit(init_fn)
-    opts = _compiler_options()
+        kw["out_shardings"] = out_shardings
+    jitted = jax.jit(init_fn, **kw)
+    opts = _compiler_options() if init_compiler_options else None
     attrs = {} if label is None else {"group": label}
     t0 = time.perf_counter()
     with observe.span("jax.lower", category="jax", **attrs):
@@ -754,7 +769,7 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
             chaos.maybe_inject(
                 "lower", gno, path=_chaos_cache_path(), plan=fault_plan
             )
-            return jitted.lower(key)
+            return jitted.lower(*args)
 
         lowered = _bounded_stage("lower", _do_lower, deadline=deadline,
                                  group=gno)

@@ -19,7 +19,7 @@ from .configs import (
     TransformerConfig,
     VisionConfig,
 )
-from .decomposition import PipelineDecomposition
+from .decomposition import DecodeDecomposition, PipelineDecomposition
 from .gpt2 import GPT2Model, make_gpt2
 from .llama import LlamaModel, make_llama
 from .mixtral import make_mixtral
@@ -45,6 +45,7 @@ __all__ = [
     "TINY_VIT",
     "VIT_B16",
     "VIT_L16",
+    "DecodeDecomposition",
     "GPT2Model",
     "LlamaModel",
     "PipelineDecomposition",

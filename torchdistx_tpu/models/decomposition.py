@@ -25,9 +25,11 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "DecodeDecomposition",
     "PipelineDecomposition",
     "apply_final_norm",
     "decoder_head_logits",
+    "positional_token_embed",
     "token_embed",
 ]
 
@@ -37,6 +39,17 @@ def token_embed(cfg, table_params, tokens: jax.Array) -> jax.Array:
     return nn.Embed(
         cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype
     ).apply({"params": table_params}, tokens)
+
+
+def positional_token_embed(cfg, wte, wpe, tokens, positions) -> jax.Array:
+    """Learned-position embed at EXPLICIT positions (GPT-2 decode: one
+    new token per lane sits at that lane's own absolute offset, not at
+    ``arange(S)``)."""
+    pos = nn.Embed(
+        cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    ).apply({"params": wpe}, positions)
+    return token_embed(cfg, wte, tokens) + pos
 
 
 def apply_final_norm(cfg, p, x: jax.Array) -> jax.Array:
@@ -75,3 +88,26 @@ class PipelineDecomposition:
     head: Callable[[Any, jax.Array], jax.Array]
     # block attention masking (False for encoder families, e.g. ViT)
     causal: bool = True
+
+
+@dataclass(frozen=True)
+class DecodeDecomposition:
+    """How a decoder-LM family maps onto the serving runtime
+    (:mod:`torchdistx_tpu.serve`): same contract as
+    :class:`PipelineDecomposition`, but position-explicit — decode feeds
+    ONE token per batch lane at that lane's own absolute offset, so the
+    embed and rotary hooks take a ``positions`` operand instead of
+    assuming ``arange(S)``.
+
+    All callables take the model's ``params["params"]`` subtree (``p``).
+    """
+
+    # p, tokens [B, S], positions [B, S] -> [B, S, d_model]
+    embed: Callable[[Any, jax.Array, jax.Array], jax.Array]
+    # p -> the scan-stacked per-layer param pytree (leading dim n_layers)
+    block_params: Callable[[Any], Any]
+    # positions [B, S] -> rope angles [B, S, head_dim/2], or None for
+    # families with learned/absolute positions (applied in embed)
+    angles_at: Callable[[jax.Array], Optional[jax.Array]]
+    # p, activations [B, S, d_model] -> logits [B, S, vocab]
+    head: Callable[[Any, jax.Array], jax.Array]

@@ -90,6 +90,35 @@ class GPT2Model(nn.Module):
 
         return PipelineDecomposition(embed, block_params, angles, head)
 
+    def decode_decomposition(self) -> "DecodeDecomposition":
+        """Export for the serving runtime (serve/engine.py): learned
+        positions are gathered at the EXPLICIT per-lane offsets (a decode
+        token's wpe row is its absolute position, not arange), no rope."""
+        from .decomposition import (
+            DecodeDecomposition,
+            apply_final_norm,
+            decoder_head_logits,
+            positional_token_embed,
+        )
+
+        cfg = self.cfg
+
+        def embed(p, tokens, positions):
+            return positional_token_embed(cfg, p["wte"], p["wpe"], tokens,
+                                          positions)
+
+        def block_params(p):
+            return p["blocks"]["block"]
+
+        def angles_at(positions):
+            return None
+
+        def head(p, x):
+            x = apply_final_norm(cfg, p, x)
+            return decoder_head_logits(cfg, p, x, p["wte"]["embedding"])
+
+        return DecodeDecomposition(embed, block_params, angles_at, head)
+
 
 def make_gpt2(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> GPT2Model:
     return GPT2Model(cfg, attn_fn=attn_fn)

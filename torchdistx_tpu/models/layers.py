@@ -111,12 +111,18 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jax.Array:
 
 
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
-    """x: [B, S, H, D]; angles: [S, D/2]."""
+    """x: [B, S, H, D]; angles: [S, D/2], or [B, S, D/2] for per-sequence
+    positions (the serving decode path rotates each batch lane at its own
+    absolute position)."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     x1, x2 = jnp.split(xf, 2, axis=-1)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if angles.ndim == 3:
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
+    else:
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(dtype)
 

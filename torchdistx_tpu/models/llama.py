@@ -115,6 +115,38 @@ class LlamaModel(nn.Module):
 
         return PipelineDecomposition(embed, block_params, angles, head)
 
+    def decode_decomposition(self) -> "DecodeDecomposition":
+        """Export for the serving runtime (serve/engine.py): position-
+        explicit embed and rope, same block/head structure as __call__.
+        The angle table is built once at ``max_seq_len`` and gathered at
+        the requested positions — ``rope_frequencies`` is an outer
+        product, so row ``p`` equals the row a full forward at length
+        ``> p`` would use."""
+        from .decomposition import (
+            DecodeDecomposition,
+            apply_final_norm,
+            decoder_head_logits,
+            token_embed,
+        )
+
+        cfg = self.cfg
+        table = rope_frequencies(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+
+        def embed(p, tokens, positions):
+            return token_embed(cfg, p["embed"], tokens)
+
+        def block_params(p):
+            return p["blocks"]["block"]
+
+        def angles_at(positions):
+            return table[positions]  # [B, S, head_dim/2]
+
+        def head(p, x):
+            x = apply_final_norm(cfg, p, x)
+            return decoder_head_logits(cfg, p, x, p["embed"]["embedding"])
+
+        return DecodeDecomposition(embed, block_params, angles_at, head)
+
 
 def make_llama(cfg: TransformerConfig, attn_fn: AttnFn = default_attention) -> LlamaModel:
     return LlamaModel(cfg, attn_fn=attn_fn)

@@ -7,11 +7,14 @@ an interpret-mode path so the full test suite runs on CPU.
 
 from .autotune import tune_flash_blocks
 from .flash_attention import flash_attention, make_flash_attention
+from .paged_attention import paged_attention, paged_attention_reference
 from .segments import normalize_segment_ids
 
 __all__ = [
     "flash_attention",
     "make_flash_attention",
     "normalize_segment_ids",
+    "paged_attention",
+    "paged_attention_reference",
     "tune_flash_blocks",
 ]

@@ -92,6 +92,20 @@ class ProgramReport:
         return d
 
 
+def classify_warm_outcome(cache_outcome: str, *, fetched: bool,
+                          published: bool) -> str:
+    """THE warm-outcome vocabulary (`ProgramReport.outcome`), shared by
+    the init-program warm (:func:`warm_sharded`) and the serving warm
+    (:func:`...serve.programs.warm_serving`) so their report lines can
+    never diverge: a local-cache hit is ``fetched`` only when registry
+    bytes actually moved during this compile (else ``cached``); a
+    compile is ``published`` only when its artifact is now in the
+    registry (else ``compiled``)."""
+    if cache_outcome == "hit":
+        return "fetched" if fetched else "cached"
+    return "published" if published else "compiled"
+
+
 def shard_owner(key: str, hosts: int) -> int:
     """Deterministic owner of one registry key in ``[0, hosts)`` — a pure
     function of the key, so every host computes the same partition
@@ -206,20 +220,15 @@ def warm_sharded(factory, cache_dir: str, *,
             program_fp=spec.program_fp if reg is not None else None,
             deadline=tdx_config.get().compile_deadline_s or None,
         )
-        if cache_outcome == "hit":
+        outcome = classify_warm_outcome(
+            cache_outcome,
             # "fetched" only when bytes actually moved from the registry
             # during THIS compile; a warm local cache reports "cached".
-            fetched = (
-                observe.counter("tdx.registry.fetch_hit").value
-                > fetches_before
-            )
-            outcome = "fetched" if fetched else "cached"
-        else:
-            published = bool(
-                reg is not None and spec.registry_key
-                and reg.has(spec.registry_key)
-            )
-            outcome = "published" if published else "compiled"
+            fetched=(observe.counter("tdx.registry.fetch_hit").value
+                     > fetches_before),
+            published=bool(reg is not None and spec.registry_key
+                           and reg.has(spec.registry_key)),
+        )
         return ProgramReport(
             program=spec.name, outputs=len(spec.idxs), outcome=outcome,
             seconds=time.perf_counter() - t,

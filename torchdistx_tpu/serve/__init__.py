@@ -1,0 +1,50 @@
+"""Inference-serving runtime: paged KV-cache, ragged decode attention,
+continuous batching, deferred-init replica bring-up (docs/serving.md).
+
+The serving counterpart of the training stack: a replica spins up via
+``deferred_init`` → registry fetch → sharded materialize (params land on
+the mesh without the host ever holding them, and a warmed registry makes
+the whole bring-up compile-free), then serves a continuous-batching loop
+whose decode step gathers each sequence's context through per-sequence
+page tables with the ragged paged-attention kernel
+(:mod:`torchdistx_tpu.ops.paged_attention`, arXiv:2604.15464).
+
+Quick tour::
+
+    from torchdistx_tpu.serve import Request, spin_up_replica
+
+    eng = spin_up_replica("tiny", serve_cfg=ServeConfig(max_batch=4))
+    out = eng.run([Request("r0", [1, 2, 3], max_new_tokens=8)])
+    # out["r0"] == the greedy continuation; equal to the unbatched
+    # oracle (serve.oracle_generate) by contract.
+"""
+
+from .engine import Request, ServeEngine, oracle_generate, spin_up_replica
+from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
+from .programs import (
+    ServeConfig,
+    ServeProgramSpec,
+    build_decode_fn,
+    build_prefill_fn,
+    compile_serving_program,
+    serve_program_specs,
+    warm_serving,
+)
+
+__all__ = [
+    "KVCacheConfig",
+    "OutOfPages",
+    "PagedKVCache",
+    "Request",
+    "ServeConfig",
+    "ServeEngine",
+    "ServeProgramSpec",
+    "build_decode_fn",
+    "build_prefill_fn",
+    "compile_serving_program",
+    "init_pools",
+    "oracle_generate",
+    "serve_program_specs",
+    "spin_up_replica",
+    "warm_serving",
+]

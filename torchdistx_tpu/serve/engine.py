@@ -1,0 +1,549 @@
+"""Continuous-batching serve loop with deferred-init replica bring-up.
+
+The inference-serving runtime's control plane.  One :class:`ServeEngine`
+is one replica: a fixed-lane decode batch (``ServeConfig.max_batch``), a
+paged KV pool (:mod:`.kv_cache`), an admission queue, and the compiled
+prefill/decode programs (:mod:`.programs`).  The loop interleaves:
+
+1. **admission** — waiting requests are admitted while a batch lane and
+   enough pages for their prompt are free; admission runs the bucketed
+   prefill program (writes the prompt's K/V into the sequence's pages,
+   emits the first token — that's the TTFT measurement point);
+2. **decode** — ONE batched step for every active lane through the
+   decode program (ragged paged attention over each lane's own context
+   length); one token per lane per step;
+3. **retirement** — lanes that hit EOS / their token budget / the
+   context cap free their pages *immediately*, so the next step's
+   admission can hand them to waiting requests.
+
+When the pool cannot cover a lane's growth the engine **preempts** the
+youngest lane (frees its pages, requeues the whole request at the front
+of the queue — greedy decode regenerates it identically), the vLLM
+recompute-preemption policy: page exhaustion costs latency, never a
+wrong or dropped response.  The chaos ``serve`` site fires at the top of
+every step; an injected (or real) runtime fault mid-batch requeues every
+active lane the same way.
+
+**Replica bring-up** (:func:`spin_up_replica`) is the deferred-init
+story end-to-end: ``abstract.deferred_init`` fakes the model (zero
+storage), the init program is compiled through
+``jax_bridge._compile_program`` — so a registry-warmed replica FETCHES
+it rather than compiling — and executes straight into (sharded) device
+memory; the prefill/decode programs ride the same path.  With
+``TDX_REGISTRY_DIR`` pre-warmed (``tools/warm_cache.py --decode``), a
+new replica's first token is gated by cache fetches, not XLA compiles
+(``make serve-smoke`` pins zero local compiles).
+
+Telemetry (docs/observability.md): ``tdx.serve.tokens_per_s``,
+``ttft_s`` (histogram), ``queue_depth``, ``kv_pages_in_use`` (from the
+allocator), ``preempted_requests``, plus ``requests_completed`` /
+``prefills`` / ``decode_steps`` counters and ``serve.step`` /
+``serve.prefill`` / ``serve.spin_up`` spans.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import chaos, observe
+from ..models import PRESETS, TransformerConfig
+from ..utils.logging import get_logger
+from .kv_cache import OutOfPages, PagedKVCache, init_pools
+from .programs import (
+    ResolvedServeConfig,
+    ServeConfig,
+    compile_serving_program,
+    make_model,
+    model_family,
+    serve_program_specs,
+)
+
+__all__ = ["Request", "ServeEngine", "oracle_generate", "spin_up_replica"]
+
+
+@dataclass
+class Request:
+    """One generation request.  ``arrival_step`` simulates staggered
+    arrivals for continuous-batching tests and soaks (a request is not
+    admissible before that engine step)."""
+
+    rid: str
+    tokens: List[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    arrival_step: int = 0
+
+
+@dataclass
+class _Lane:
+    """One active batch lane."""
+
+    req: Request
+    seq_id: int
+    slot: int
+    length: int = 0                # tokens currently in the KV cache
+    generated: List[int] = field(default_factory=list)
+    admitted_step: int = 0
+
+
+class ServeEngine:
+    """One serving replica; see the module docstring for the loop."""
+
+    def __init__(
+        self,
+        family: str,
+        cfg: TransformerConfig,
+        params,
+        *,
+        serve_cfg: Optional[ServeConfig] = None,
+        mesh=None,
+        plan=None,
+        seed: int = 0,
+        param_dtype=None,
+        on_token: Optional[Callable[[str, int], None]] = None,
+    ):
+        self.family = family
+        self.cfg = cfg
+        self.params = params
+        self.scfg: ResolvedServeConfig = (serve_cfg or ServeConfig()).resolve(cfg)
+        self.mesh, self.plan = mesh, plan
+        self._seed, self._param_dtype = seed, param_dtype
+        self.on_token = on_token
+        self.kv = PagedKVCache(self.scfg.kv_config(cfg))
+        self.k_pages, self.v_pages = init_pools(self.scfg.kv_config(cfg),
+                                                cfg.dtype)
+        self._programs: Dict[str, object] = {}
+        self._spec_cache: Optional[Dict[str, object]] = None
+        self.waiting: deque[Request] = deque()
+        self.active: Dict[int, _Lane] = {}      # slot -> lane
+        self._delivered: Dict[str, int] = {}    # rid -> tokens streamed
+        self.results: Dict[str, List[int]] = {}
+        self.final_logits: Dict[str, np.ndarray] = {}
+        self._step_no = 0
+        self._next_seq = 1
+        self._t0: Optional[float] = None
+        self._tokens_out = 0
+        from ..jax_bridge.materialize import _retryable_errors
+
+        self._retryable = _retryable_errors()
+
+    # -- program cache ------------------------------------------------------
+
+    def _all_specs(self) -> Dict[str, object]:
+        """name → ServeProgramSpec for every program this replica shape
+        can run (decode + all prefill buckets), built ONCE — the spec
+        construction re-traces the model's init, so spin_up_replica
+        seeds this cache with the list it already built."""
+        if self._spec_cache is None:
+            specs = serve_program_specs(
+                self.family, self.cfg, ServeConfig(
+                    max_batch=self.scfg.max_batch,
+                    page_size=self.scfg.page_size,
+                    n_pages=self.scfg.n_pages,
+                    max_pages_per_seq=self.scfg.max_pages_per_seq,
+                    prefill_buckets=self.scfg.prefill_buckets,
+                    max_new_tokens=self.scfg.max_new_tokens,
+                ),
+                seed=self._seed, param_dtype=self._param_dtype,
+                mesh=self.mesh, plan=self.plan,
+                include_init=False,
+            )
+            self._spec_cache = {s.name: s for s in specs}
+        return self._spec_cache
+
+    def _program(self, name: str):
+        """The compiled program for ``name`` ('decode' or
+        'prefill-<bucket>'), compiled through the registry path on first
+        use."""
+        prog = self._programs.get(name)
+        if prog is None:
+            spec = self._all_specs().get(name)
+            if spec is None:  # pragma: no cover — name is engine-built
+                raise ValueError(f"unknown serving program {name!r}")
+            prog, _ = compile_serving_program(spec)
+            self._programs[name] = prog
+        return prog
+
+    def warmup(self) -> Dict[str, str]:
+        """Compile decode + every prefill bucket now (spin-up does this
+        so the first request pays no compile); returns name → cache
+        outcome — the zero-local-compile gate reads these."""
+        outcomes: Dict[str, str] = {}
+        for name, spec in self._all_specs().items():
+            if name not in self._programs:
+                prog, outcome = compile_serving_program(spec)
+                self._programs[name] = prog
+                outcomes[name] = outcome
+        return outcomes
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        need = self.kv.cfg.pages_for(len(req.tokens) + 1)
+        if need > self.kv.cfg.usable_pages:
+            raise ValueError(
+                f"request {req.rid}: prompt of {len(req.tokens)} tokens "
+                f"needs {need} pages but the pool only has "
+                f"{self.kv.cfg.usable_pages}"
+            )
+        if len(req.tokens) + req.max_new_tokens > self.scfg.max_context:
+            raise ValueError(
+                f"request {req.rid}: prompt + budget "
+                f"({len(req.tokens)} + {req.max_new_tokens}) exceeds "
+                f"max_context={self.scfg.max_context}"
+            )
+        if len(req.tokens) > self.scfg.prefill_buckets[-1]:
+            # Explicit bucket lists may cap below max_context; reject at
+            # the door — an oversized request must never dequeue and
+            # then kill the loop for everyone else.
+            raise ValueError(
+                f"request {req.rid}: prompt of {len(req.tokens)} tokens "
+                f"exceeds the largest prefill bucket "
+                f"{self.scfg.prefill_buckets[-1]}"
+            )
+        if not req.tokens:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if req.max_new_tokens < 1:
+            # A zero budget would still emit prefill's first token,
+            # diverging from the oracle (which generates nothing).
+            raise ValueError(
+                f"request {req.rid}: max_new_tokens must be >= 1, got "
+                f"{req.max_new_tokens}"
+            )
+        req._submit_t = time.perf_counter()
+        self.waiting.append(req)
+        self._gauges()
+
+    def run(self, requests: Sequence[Request] = (), *,
+            max_steps: int = 100_000) -> Dict[str, List[int]]:
+        """Submit ``requests`` and drive the loop until every request
+        completed (or ``max_steps``); returns the replica's cumulative
+        rid → generated-tokens map (results persist across ``run``
+        calls, like any server's response log)."""
+        for r in requests:
+            self.submit(r)
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        start = self._step_no  # budget is per CALL; _step_no is lifetime
+        while (self.waiting or self.active) and (
+                self._step_no - start) < max_steps:
+            self.step()
+        if self.waiting or self.active:
+            raise RuntimeError(
+                f"serve loop hit max_steps={max_steps} with "
+                f"{len(self.waiting)} waiting / {len(self.active)} active"
+            )
+        return dict(self.results)
+
+    def step(self) -> None:
+        """One engine tick: chaos site → admission (+prefill) → one
+        batched decode step → retirement.  A retryable runtime fault
+        mid-batch requeues every active lane (recompute preemption)."""
+        self._step_no += 1
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        with observe.span(
+            "serve.step", category="serve", step=self._step_no,
+            active=len(self.active), waiting=len(self.waiting),
+        ):
+            try:
+                chaos.maybe_inject("serve", self._step_no,
+                                   plan=chaos.active_plan())
+                self._admit()
+                self._decode_step()
+            except self._retryable as e:
+                get_logger().warning(
+                    "serve: step %d fault (%s: %s); requeueing %d active "
+                    "requests", self._step_no, type(e).__name__,
+                    str(e)[:120], len(self.active),
+                )
+                observe.instant("serve.fault", category="serve",
+                                step=self._step_no, error=type(e).__name__)
+                for slot in list(self.active):
+                    self._preempt(slot, reason="fault")
+        self._gauges()
+
+    # -- admission / prefill ------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for s in range(self.scfg.max_batch):
+            if s not in self.active:
+                return s
+        return None
+
+    def _admit(self) -> None:
+        while self.waiting:
+            req = self.waiting[0]
+            if req.arrival_step > self._step_no:
+                break
+            slot = self._free_slot()
+            if slot is None:
+                break
+            if not self.kv.can_fit(len(req.tokens)):
+                break  # retirement will free pages; keep FIFO order
+            self.waiting.popleft()
+            self._prefill(req, slot)
+
+    def _prefill(self, req: Request, slot: int) -> None:
+        L = len(req.tokens)
+        bucket = self.scfg.bucket_for(L)
+        sid = self._next_seq
+        self._next_seq += 1
+        self.kv.alloc(sid, L)
+        lane = _Lane(req=req, seq_id=sid, slot=slot, length=L,
+                     admitted_step=self._step_no)
+        try:
+            with observe.span(
+                "serve.prefill", category="serve", rid=req.rid, tokens=L,
+                bucket=bucket,
+            ):
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :L] = req.tokens
+                row = np.asarray(
+                    [self.kv.table_row(sid, self.scfg.max_pages_per_seq)],
+                    np.int32,
+                )
+                logits, self.k_pages, self.v_pages = self._program(
+                    f"prefill-{bucket}"
+                )(self.params, self.k_pages, self.v_pages, jnp.asarray(toks),
+                  jnp.asarray([L], jnp.int32), jnp.asarray(row))
+                logits = np.asarray(logits)
+        except BaseException:
+            # The request left the queue and its pages are allocated,
+            # but it is not in `active` yet — step()'s fault handler
+            # cannot see it.  Undo here so a mid-prefill fault (device,
+            # or a chaos compile/cache-site fault through the lazy
+            # program compile) costs latency, never a dropped request
+            # or leaked pages; retryable errors then requeue the rest
+            # of the batch in step().
+            self.kv.free(sid)
+            self.waiting.appendleft(req)
+            observe.counter("tdx.serve.preempted_requests").inc()
+            observe.instant("serve.preempt", category="serve",
+                            rid=req.rid, reason="prefill_fault",
+                            step=self._step_no)
+            raise
+        self.active[slot] = lane
+        # A re-prefill after preemption replays a first token the client
+        # already received — it must not contribute a (huge, bogus) TTFT
+        # sample; prefills/prefill_tokens keep counting, they measure
+        # engine work, not delivery.
+        first_delivery = self._delivered.get(req.rid, 0) == 0
+        self._emit(lane, int(np.argmax(logits)), logits)
+        observe.counter("tdx.serve.prefills").inc()
+        observe.counter("tdx.serve.prefill_tokens").inc(L)
+        if first_delivery:
+            ttft = time.perf_counter() - getattr(req, "_submit_t",
+                                                 time.perf_counter())
+            observe.histogram("tdx.serve.ttft_s").observe(ttft)
+
+    # -- decode ---------------------------------------------------------------
+
+    def _ensure_capacity(self) -> None:
+        """Every active lane must own a page slot for its next token;
+        preempt the youngest lanes until the pool covers the rest."""
+        for slot in sorted(self.active,
+                           key=lambda s: (self.active[s].admitted_step, s)):
+            lane = self.active.get(slot)
+            if lane is None:
+                continue
+            while True:
+                try:
+                    self.kv.extend(lane.seq_id, lane.length + 1)
+                    break
+                except OutOfPages:
+                    victim = max(
+                        self.active,
+                        key=lambda s: (self.active[s].admitted_step, s),
+                    )
+                    self._preempt(victim, reason="pages")
+                    if victim == slot:
+                        break  # this lane itself was the youngest
+
+    def _decode_step(self) -> None:
+        if not self.active:
+            return
+        self._ensure_capacity()
+        if not self.active:
+            return
+        B = self.scfg.max_batch
+        maxp = self.scfg.max_pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        table = np.zeros((B, maxp), np.int32)
+        for slot, lane in self.active.items():
+            tokens[slot] = (lane.generated[-1] if lane.generated
+                            else lane.req.tokens[-1])
+            positions[slot] = lane.length
+            table[slot] = self.kv.table_row(lane.seq_id, maxp)
+        logits, self.k_pages, self.v_pages = self._program("decode")(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(table),
+        )
+        logits = np.asarray(logits)
+        for slot in list(self.active):
+            lane = self.active[slot]
+            lane.length += 1
+            self._emit(lane, int(np.argmax(logits[slot])), logits[slot])
+        observe.counter("tdx.serve.decode_steps").inc()
+
+    def _emit(self, lane: _Lane, token: int, logits: np.ndarray) -> None:
+        lane.generated.append(token)
+        # Recompute preemption replays a requeued request from scratch
+        # (greedy decode regenerates the SAME prefix); positions the
+        # client already received must not stream twice, and the
+        # tokens_per_s gauge counts DELIVERED tokens, not redone work.
+        pos = len(lane.generated)
+        rid = lane.req.rid
+        if pos > self._delivered.get(rid, 0):
+            self._delivered[rid] = pos
+            self._tokens_out += 1
+            if self.on_token is not None:
+                self.on_token(rid, token)
+        req = lane.req
+        done = (
+            (req.eos_id is not None and token == req.eos_id)
+            or len(lane.generated) >= req.max_new_tokens
+            or lane.length >= self.scfg.max_context
+        )
+        if done:
+            self._retire(lane, logits)
+
+    def _retire(self, lane: _Lane, logits: np.ndarray) -> None:
+        self.kv.free(lane.seq_id)
+        self.active.pop(lane.slot, None)
+        self._delivered.pop(lane.req.rid, None)
+        self.results[lane.req.rid] = list(lane.generated)
+        self.final_logits[lane.req.rid] = np.asarray(logits, np.float32)
+        observe.counter("tdx.serve.requests_completed").inc()
+
+    def _preempt(self, slot: int, *, reason: str) -> None:
+        """Evict a lane and requeue its whole request at the queue front
+        (recompute policy: greedy decode regenerates identically)."""
+        lane = self.active.pop(slot)
+        self.kv.free(lane.seq_id)
+        self.waiting.appendleft(lane.req)
+        observe.counter("tdx.serve.preempted_requests").inc()
+        observe.instant("serve.preempt", category="serve",
+                        rid=lane.req.rid, reason=reason,
+                        step=self._step_no)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _gauges(self) -> None:
+        if not observe.enabled():
+            return
+        observe.gauge("tdx.serve.queue_depth").set(len(self.waiting))
+        observe.gauge("tdx.serve.active_requests").set(len(self.active))
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            if dt > 0:
+                observe.gauge("tdx.serve.tokens_per_s").set(
+                    round(self._tokens_out / dt, 3)
+                )
+
+
+# ---------------------------------------------------------------------------
+# replica bring-up + oracle
+# ---------------------------------------------------------------------------
+
+
+def spin_up_replica(
+    model: "str | TransformerConfig" = "tiny",
+    *,
+    family: Optional[str] = None,
+    serve_cfg: Optional[ServeConfig] = None,
+    mesh=None,
+    plan=None,
+    seed: int = 0,
+    param_dtype=None,
+    sample_len: int = 8,
+    warm: bool = True,
+    on_token=None,
+) -> ServeEngine:
+    """Bring up one serving replica: ``deferred_init`` the model (fakes,
+    zero storage) → compile/fetch the init program through the artifact
+    registry → materialize params (sharded onto ``mesh`` when given) →
+    compile/fetch the prefill + decode programs.  With a pre-warmed
+    registry every one of these is a cache fetch, not an XLA compile —
+    the autoscaling bring-up contract (docs/serving.md).
+
+    ``model`` is a zoo preset name (family inferred from it) or a
+    :class:`TransformerConfig` (then pass ``family``).
+    """
+    if isinstance(model, str):
+        cfg = PRESETS[model]
+        if not isinstance(cfg, TransformerConfig):
+            raise ValueError(f"preset {model!r} is not a decoder LM")
+        family = family or model_family(model)
+    else:
+        cfg = model
+        family = family or "llama"
+    t0 = time.perf_counter()
+    with observe.span(
+        "serve.spin_up", category="serve", family=family,
+        warm=bool(warm),
+    ) as sp:
+        specs = serve_program_specs(
+            family, cfg, serve_cfg, seed=seed, param_dtype=param_dtype,
+            mesh=mesh, plan=plan, sample_len=sample_len,
+        )
+        init = specs[0]
+        assert init.name == "init"
+        compiled, init_outcome = compile_serving_program(init)
+        values = compiled()
+        params = jax.tree.unflatten(init.treedef, list(values))
+        jax.block_until_ready(values)
+        engine = ServeEngine(
+            family, cfg, params, serve_cfg=serve_cfg, mesh=mesh, plan=plan,
+            seed=seed, param_dtype=param_dtype, on_token=on_token,
+        )
+        # The spec list above already paid the model's deferred-init
+        # trace; hand it to the engine so warmup/lazy compiles reuse it.
+        engine._spec_cache = {s.name: s for s in specs if s.name != "init"}
+        outcomes = {"init": init_outcome}
+        if warm:
+            outcomes.update(engine.warmup())
+        engine.bring_up_outcomes = outcomes
+        engine.bring_up_seconds = time.perf_counter() - t0
+        sp.set(seconds=round(engine.bring_up_seconds, 3), **{
+            f"cache_{k}": v for k, v in outcomes.items()
+        })
+    return engine
+
+
+def oracle_generate(
+    family: str,
+    cfg: TransformerConfig,
+    params,
+    prompt: Sequence[int],
+    max_new_tokens: int,
+    eos_id: Optional[int] = None,
+):
+    """The no-batching, no-cache greedy oracle: full forward over the
+    growing sequence through the stock flax model, argmax each step.
+    Returns ``(generated_tokens, final_step_logits)`` — what the engine
+    must reproduce for the same request, whatever batching, paging,
+    preemption, or faults happened along the way."""
+    model = make_model(family, cfg)
+    toks = list(prompt)
+    out: List[int] = []
+    logits_last = None
+    for _ in range(max_new_tokens):
+        logits = model.apply(params, jnp.asarray([toks], jnp.int32))
+        logits_last = np.asarray(logits[0, -1], np.float32)
+        t = int(np.argmax(logits_last))
+        out.append(t)
+        toks.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+        if len(toks) >= cfg.max_seq_len:
+            break
+    return out, logits_last

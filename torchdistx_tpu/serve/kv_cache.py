@@ -1,0 +1,234 @@
+"""Paged KV-cache: fixed-size pages in a preallocated device pool.
+
+The serving engine's memory manager.  Instead of one contiguous
+[B, max_seq, KV, D] cache per sequence (whose worst-case reservation is
+what kills batch size), K/V live in a pool of fixed-size **pages**
+([n_pages, page_size, kv_heads, head_dim] per layer, allocated once at
+replica bring-up), and each sequence owns an ordered list of page ids —
+its **page table**.  Admission cost is ``ceil(len / page_size)`` pages,
+growth is one page at a time, retirement returns pages to the free list
+immediately for waiting requests; external fragmentation is zero by
+construction and internal fragmentation is bounded by one page per
+sequence (the vLLM/PagedAttention memory model, arXiv:2604.15464's
+layout).
+
+Split of responsibilities:
+
+* **host side (this class)** — the free list, per-sequence page tables,
+  alloc/extend/free, and the occupancy / fragmentation gauges.  Pure
+  Python bookkeeping; every mutation is O(pages touched).
+* **device side** — the pools themselves are jax arrays owned by the
+  engine and threaded *functionally* through the compiled prefill /
+  decode programs (which scatter new K/V into pages and gather context
+  through the page table via :func:`torchdistx_tpu.ops.paged_attention`).
+
+Page 0 is reserved as the **null page**: batch-padding slots and
+prompt-padding positions route their writes there, so padded lanes of a
+fixed-shape program never touch a live sequence's memory and need no
+masking in the scatter.  The null page is never handed out and never
+read (idle lanes carry ``length == 0``).
+
+Telemetry (docs/observability.md): ``tdx.serve.kv_pages_in_use``,
+``tdx.serve.kv_occupancy`` (used token slots / allocated slots in live
+pages — the internal-fragmentation complement), and
+``tdx.serve.kv_pool_pages`` gauges, refreshed on every mutation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from .. import observe
+
+__all__ = ["KVCacheConfig", "OutOfPages", "PagedKVCache"]
+
+
+class OutOfPages(RuntimeError):
+    """The pool cannot satisfy an alloc/extend; the engine responds by
+    deferring admission or preempting a sequence, never by failing the
+    request."""
+
+
+@dataclass(frozen=True)
+class KVCacheConfig:
+    """Shape of the device pool (one K and one V pool, all layers)."""
+
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int = 16
+    n_pages: int = 64  # includes the reserved null page 0
+
+    @property
+    def usable_pages(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def tokens_capacity(self) -> int:
+        """Token slots available to live sequences (null page excluded)."""
+        return self.usable_pages * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` of context."""
+        return max(0, -(-n_tokens // self.page_size))
+
+    def pool_shape(self) -> Tuple[int, int, int, int, int]:
+        """[L, P, page, KV, D] — the per-pool (K or V) array shape."""
+        return (self.n_layers, self.n_pages, self.page_size,
+                self.kv_heads, self.head_dim)
+
+
+@dataclass
+class _Seq:
+    pages: List[int] = field(default_factory=list)
+    length: int = 0  # tokens currently stored
+
+
+class PagedKVCache:
+    """Host-side page allocator: free list + per-sequence page tables.
+
+    The device pools are NOT stored here (the engine owns them and
+    threads them through its compiled programs); :meth:`pool_shape` and
+    :func:`init_pools` build them.
+    """
+
+    def __init__(self, cfg: KVCacheConfig):
+        if cfg.n_pages < 2:
+            raise ValueError(
+                f"n_pages must be >= 2 (page 0 is the reserved null "
+                f"page), got {cfg.n_pages}"
+            )
+        if cfg.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {cfg.page_size}")
+        self.cfg = cfg
+        # LIFO free list: recently-freed pages are reused first (their
+        # pool slices are most likely still warm in device caches).
+        self._free: List[int] = list(range(cfg.n_pages - 1, 0, -1))
+        self._seqs: Dict[int, _Seq] = {}
+        self._update_gauges()
+
+    # -- queries ------------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.cfg.usable_pages - len(self._free)
+
+    def length(self, seq_id: int) -> int:
+        return self._seqs[seq_id].length
+
+    def page_ids(self, seq_id: int) -> List[int]:
+        return list(self._seqs[seq_id].pages)
+
+    def has(self, seq_id: int) -> bool:
+        return seq_id in self._seqs
+
+    def occupancy(self) -> float:
+        """Used token slots / allocated slots in live pages (1.0 = no
+        internal fragmentation; 0.0 when nothing is allocated)."""
+        alloc = sum(len(s.pages) for s in self._seqs.values())
+        if not alloc:
+            return 0.0
+        used = sum(s.length for s in self._seqs.values())
+        return used / (alloc * self.cfg.page_size)
+
+    def fragmentation(self) -> float:
+        """Wasted fraction of allocated slots (``1 - occupancy`` over
+        live pages): the tail-page waste bound the paged layout trades
+        for zero external fragmentation."""
+        return 0.0 if not self._seqs else 1.0 - self.occupancy()
+
+    def can_fit(self, n_tokens: int) -> bool:
+        return self.cfg.pages_for(n_tokens) <= len(self._free)
+
+    # -- mutations ----------------------------------------------------------
+
+    def alloc(self, seq_id: int, n_tokens: int) -> List[int]:
+        """Allocate pages for a new sequence holding ``n_tokens``;
+        returns its page ids.  Raises :class:`OutOfPages` (allocating
+        nothing) when the free list cannot cover it."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        need = self.cfg.pages_for(n_tokens)
+        if need > len(self._free):
+            raise OutOfPages(
+                f"need {need} pages for {n_tokens} tokens, "
+                f"{len(self._free)} free"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        self._seqs[seq_id] = _Seq(pages=pages, length=n_tokens)
+        self._update_gauges()
+        return list(pages)
+
+    def extend(self, seq_id: int, new_length: int) -> List[int]:
+        """Grow ``seq_id`` to hold ``new_length`` tokens, allocating at
+        most the pages the growth needs; returns the pages ADDED.  On
+        :class:`OutOfPages` nothing changes — the engine preempts a
+        victim and retries."""
+        seq = self._seqs[seq_id]
+        if new_length < seq.length:
+            raise ValueError(
+                f"extend cannot shrink: {seq.length} -> {new_length}"
+            )
+        need = self.cfg.pages_for(new_length) - len(seq.pages)
+        if need > len(self._free):
+            raise OutOfPages(
+                f"sequence {seq_id} needs {need} more pages, "
+                f"{len(self._free)} free"
+            )
+        added = [self._free.pop() for _ in range(max(0, need))]
+        seq.pages.extend(added)
+        seq.length = new_length
+        if added:
+            self._update_gauges()
+        return added
+
+    def free(self, seq_id: int) -> int:
+        """Retire a sequence, returning its pages to the free list;
+        returns how many pages were freed.  Unknown ids are a no-op
+        (retire paths race with preemption paths by design)."""
+        seq = self._seqs.pop(seq_id, None)
+        if seq is None:
+            return 0
+        self._free.extend(reversed(seq.pages))
+        self._update_gauges()
+        return len(seq.pages)
+
+    def reset(self) -> None:
+        """Free every sequence (replica drain)."""
+        for sid in list(self._seqs):
+            self.free(sid)
+
+    # -- batch views --------------------------------------------------------
+
+    def table_row(self, seq_id: int, max_pages: int) -> List[int]:
+        """The sequence's page table padded with the null page to a
+        fixed-width row (the decode program's [B, max_pages] operand)."""
+        pages = self._seqs[seq_id].pages
+        if len(pages) > max_pages:
+            raise ValueError(
+                f"sequence {seq_id} holds {len(pages)} pages > "
+                f"max_pages={max_pages}"
+            )
+        return pages + [0] * (max_pages - len(pages))
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        if not observe.enabled():
+            return
+        observe.gauge("tdx.serve.kv_pages_in_use").set(self.pages_in_use)
+        observe.gauge("tdx.serve.kv_pool_pages").set(self.cfg.usable_pages)
+        observe.gauge("tdx.serve.kv_occupancy").set(round(self.occupancy(), 4))
+
+
+def init_pools(cfg: KVCacheConfig, dtype) -> Tuple["jax.Array", "jax.Array"]:
+    """The zeroed device pools (k_pages, v_pages), [L, P, page, KV, D]."""
+    import jax.numpy as jnp
+
+    shape = cfg.pool_shape()
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

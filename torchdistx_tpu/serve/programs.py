@@ -1,0 +1,611 @@
+"""Serving programs: prefill/decode forward builders + registry-aware
+compiles.
+
+The serving runtime runs THREE compiled program kinds per replica, all
+built here so the engine, the warm tool, and the smoke tests construct
+byte-identical programs:
+
+* **init** — the replica's sharded parameter materialization: the
+  :mod:`..abstract` deferred-init thunk jitted with the plan's
+  ``out_shardings`` (zero-storage ``deferred_init`` on any host, params
+  land sharded on the replica mesh);
+* **prefill-<bucket>** — one prompt (padded to a deterministic
+  power-of-two bucket) through the full stack with causal attention,
+  writing its K/V into the paged pool and returning the last valid
+  position's logits (the first generated token);
+* **decode** — one token per batch lane through the stack, K/V scattered
+  into each lane's current page/slot, context attended through the page
+  table via :func:`torchdistx_tpu.ops.paged_attention`, logits out.
+
+Every compile goes through
+:func:`..jax_bridge.materialize._compile_program`, so the pod-scale
+artifact registry (``TDX_REGISTRY_DIR``), the persistent compile cache,
+the exact hit/miss counters, the compile watchdog, and the chaos
+``lower``/``compile``/``cache``/``registry`` sites all cover serving
+programs exactly as they cover init programs.  Program fingerprints are
+pure functions of (family, model config, serve shape) — every host
+derives the same registry key, which is what makes
+``tools/warm_cache.py --decode`` + a shared registry a ZERO-compile
+replica bring-up (``make serve-smoke`` pins this).
+
+Decode-mode block math mirrors the flax models exactly by applying the
+SAME flax submodules (``DenseGeneral`` / ``MLP`` / ``make_norm``) to the
+recorded param subtrees — the idiom the pipeline runner established
+(models/decomposition.py) — so there is no second implementation of the
+projections to drift; only the attention differs (paged vs dense), and
+that is pinned against the dense oracle by tests and the smoke gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import abstract, chaos, observe
+from .. import config as tdx_config
+from ..models import TransformerConfig, make_gpt2, make_llama
+from ..models.layers import MLP, apply_rope, default_attention, make_norm
+from ..ops import paged_attention
+from ..utils.logging import get_logger
+from .kv_cache import KVCacheConfig
+
+__all__ = [
+    "ServeConfig",
+    "ServeProgramSpec",
+    "build_decode_fn",
+    "build_prefill_fn",
+    "compile_serving_program",
+    "make_model",
+    "model_family",
+    "serve_program_specs",
+    "warm_serving",
+]
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Shape of one replica's serving runtime.  Everything here is part
+    of the compiled programs' identity (and so of their registry keys):
+    a warm and a serve with different ServeConfigs are different
+    programs by design."""
+
+    max_batch: int = 4          # decode lanes (fixed-shape batch)
+    page_size: int = 16
+    n_pages: int = 64           # pool pages, incl. the reserved null page
+    max_pages_per_seq: Optional[int] = None  # default: fits max_seq_len
+    prefill_buckets: Tuple[int, ...] = ()    # default: powers of two
+    max_new_tokens: int = 16    # default per-request budget
+
+    def resolve(self, cfg: TransformerConfig) -> "ResolvedServeConfig":
+        page = self.page_size
+        maxp = self.max_pages_per_seq
+        cap = min(cfg.max_seq_len, (self.n_pages - 1) * page)
+        if maxp is None:
+            maxp = -(-cap // page)
+        max_context = min(cap, maxp * page)
+        buckets = tuple(self.prefill_buckets)
+        if not buckets:
+            b, acc = 8, []
+            while b < max_context:
+                acc.append(b)
+                b *= 2
+            acc.append(max_context)
+            buckets = tuple(sorted(set(acc)))
+        else:
+            buckets = tuple(sorted({min(b, max_context) for b in buckets}))
+        return ResolvedServeConfig(
+            max_batch=self.max_batch, page_size=page, n_pages=self.n_pages,
+            max_pages_per_seq=maxp, prefill_buckets=buckets,
+            max_new_tokens=self.max_new_tokens, max_context=max_context,
+        )
+
+
+@dataclass(frozen=True)
+class ResolvedServeConfig:
+    """A :class:`ServeConfig` with every default pinned against one model
+    config — the form program fingerprints and the engine consume."""
+
+    max_batch: int
+    page_size: int
+    n_pages: int
+    max_pages_per_seq: int
+    prefill_buckets: Tuple[int, ...]
+    max_new_tokens: int
+    max_context: int
+
+    def kv_config(self, cfg: TransformerConfig) -> KVCacheConfig:
+        return KVCacheConfig(
+            n_layers=cfg.n_layers, kv_heads=cfg.kv_heads,
+            head_dim=cfg.head_size, page_size=self.page_size,
+            n_pages=self.n_pages,
+        )
+
+    def bucket_for(self, n_tokens: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n_tokens:
+                return b
+        raise ValueError(
+            f"prompt of {n_tokens} tokens exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]} (max_context="
+            f"{self.max_context})"
+        )
+
+
+def model_family(name: str) -> str:
+    """The decode family of a zoo preset name: gpt2 presets by name, any
+    other dense decoder serves through the llama path."""
+    return "gpt2" if "gpt2" in name else "llama"
+
+
+def make_model(family: str, cfg: TransformerConfig):
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "the serving runtime covers the dense decoder families "
+            "(gpt2, llama); MoE decode is future work"
+        )
+    if family == "gpt2":
+        return make_gpt2(cfg)
+    if family == "llama":
+        return make_llama(cfg)
+    raise ValueError(f"unknown decode family {family!r} (gpt2 | llama)")
+
+
+# ---------------------------------------------------------------------------
+# decode-mode block forward (shared by prefill and decode)
+# ---------------------------------------------------------------------------
+
+
+def _norm_keys(cfg: TransformerConfig) -> Tuple[str, str]:
+    base = "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
+    return f"{base}_0", f"{base}_1"
+
+
+def _qkv(cfg: TransformerConfig, attn_p, h):
+    """The models' exact projections: the same ``nn.DenseGeneral``
+    modules ``models.layers.Attention`` builds, applied to the stored
+    subtrees."""
+    D = cfg.head_size
+
+    def dense(feats, p):
+        return nn.DenseGeneral(
+            feats, axis=-1, use_bias=cfg.use_bias, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        ).apply({"params": p}, h)
+
+    q = dense((cfg.n_heads, D), attn_p["wq"])
+    k = dense((cfg.kv_heads, D), attn_p["wk"])
+    v = dense((cfg.kv_heads, D), attn_p["wv"])
+    return q, k, v
+
+
+def _attn_out(cfg: TransformerConfig, attn_p, o):
+    return nn.DenseGeneral(
+        cfg.d_model, axis=(-2, -1), use_bias=cfg.use_bias, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    ).apply({"params": attn_p["wo"]}, o)
+
+
+def _mlp(cfg: TransformerConfig, blk, x):
+    return MLP(cfg).apply({"params": blk["mlp"]}, x)
+
+
+def _decode_block(cfg, blk, x, kp, vp, *, angles, positions, lengths,
+                  page_table):
+    """One layer of the decode step: x [B, 1, d]; writes this token's
+    K/V at (page, slot) and attends the whole context through the page
+    table."""
+    n0, n1 = _norm_keys(cfg)
+    page_size = kp.shape[1]
+    B = x.shape[0]
+    h = make_norm(cfg).apply({"params": blk[n0]}, x)
+    q, k, v = _qkv(cfg, blk["attn"], h)
+    if angles is not None:
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+    page = page_table[jnp.arange(B), positions // page_size]
+    slot = positions % page_size
+    kp = kp.at[page, slot].set(k[:, 0])
+    vp = vp.at[page, slot].set(v[:, 0])
+    attn = paged_attention(q[:, 0], kp, vp, lengths, page_table)
+    x = x + _attn_out(cfg, blk["attn"], attn[:, None])
+    h2 = make_norm(cfg).apply({"params": blk[n1]}, x)
+    x = x + _mlp(cfg, blk, h2)
+    return x, kp, vp
+
+
+def _prefill_block(cfg, blk, x, kp, vp, *, angles, positions, length,
+                   page_table):
+    """One layer of prefill: x [B, S, d]; causal attention over the
+    in-flight K/V (a fresh prompt attends only itself), every valid
+    position's K/V scattered into its page; padded positions write the
+    null page and are segment-masked out of the valid rows."""
+    n0, n1 = _norm_keys(cfg)
+    page_size = kp.shape[1]
+    maxp = page_table.shape[1]
+    B = x.shape[0]
+    h = make_norm(cfg).apply({"params": blk[n0]}, x)
+    q, k, v = _qkv(cfg, blk["attn"], h)
+    if angles is not None:
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+    valid = positions < length[:, None]  # [B, S]
+    pidx = jnp.minimum(positions // page_size, maxp - 1)
+    page = jnp.where(valid, jnp.take_along_axis(page_table, pidx, axis=1), 0)
+    slot = jnp.where(valid, positions % page_size, 0)
+    kp = kp.at[page, slot].set(k)
+    vp = vp.at[page, slot].set(v)
+    attn = default_attention(q, k, v, causal=True,
+                             segment_ids=valid.astype(jnp.int32))
+    x = x + _attn_out(cfg, blk["attn"], attn)
+    h2 = make_norm(cfg).apply({"params": blk[n1]}, x)
+    x = x + _mlp(cfg, blk, h2)
+    return x, kp, vp
+
+
+def _scan_blocks(decomp, p, x, k_pages, v_pages, block_step):
+    """Thread x through the scan-stacked layers; the per-layer pool
+    slices ride the scan as mapped inputs/outputs, so the whole stack's
+    cache update is one functional pass."""
+    blocks = decomp.block_params(p)
+
+    def body(carry, inp):
+        blk, kp, vp = inp
+        y, kp, vp = block_step(blk, carry, kp, vp)
+        return y, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(body, x, (blocks, k_pages, v_pages))
+    return x, k_pages, v_pages
+
+
+def build_decode_fn(family: str, cfg: TransformerConfig,
+                    scfg: ResolvedServeConfig) -> Callable:
+    """The batched decode-step program:
+    ``(params, k_pages, v_pages, tokens [B], positions [B],
+    page_table [B, maxp]) -> (logits [B, vocab], k_pages, v_pages)``.
+    ``positions[b]`` is the index the incoming token occupies; idle
+    lanes carry position 0 and a null page table (their writes land in
+    the null page, their logits are ignored)."""
+    decomp = make_model(family, cfg).decode_decomposition()
+
+    def decode_fn(params, k_pages, v_pages, tokens, positions, page_table):
+        p = params["params"]
+        x = decomp.embed(p, tokens[:, None], positions[:, None])
+        angles = decomp.angles_at(positions[:, None])
+        # Context including the incoming token; idle lanes (position 0
+        # — active lanes always hold at least their non-empty prompt)
+        # get length 0, the kernel's documented idle contract, so the
+        # null page is written by their scatters but never READ.
+        lengths = jnp.where(positions > 0, positions + 1, 0)
+
+        def step(blk, x, kp, vp):
+            return _decode_block(
+                cfg, blk, x, kp, vp, angles=angles, positions=positions,
+                lengths=lengths, page_table=page_table,
+            )
+
+        x, k_pages, v_pages = _scan_blocks(
+            decomp, p, x, k_pages, v_pages, step
+        )
+        logits = decomp.head(p, x)[:, 0]  # [B, vocab]
+        return logits, k_pages, v_pages
+
+    return decode_fn
+
+
+def build_prefill_fn(family: str, cfg: TransformerConfig,
+                     scfg: ResolvedServeConfig, bucket: int) -> Callable:
+    """The single-sequence prefill program for one prompt bucket:
+    ``(params, k_pages, v_pages, tokens [1, bucket], length [1],
+    page_table [1, maxp]) -> (logits [vocab], k_pages, v_pages)`` —
+    logits are the LAST VALID position's (the first generated token)."""
+    decomp = make_model(family, cfg).decode_decomposition()
+
+    def prefill_fn(params, k_pages, v_pages, tokens, length, page_table):
+        p = params["params"]
+        S = tokens.shape[1]
+        positions = jnp.arange(S, dtype=jnp.int32)[None]
+        x = decomp.embed(p, tokens, positions)
+        angles = decomp.angles_at(positions)
+
+        def step(blk, x, kp, vp):
+            return _prefill_block(
+                cfg, blk, x, kp, vp, angles=angles, positions=positions,
+                length=length, page_table=page_table,
+            )
+
+        x, k_pages, v_pages = _scan_blocks(
+            decomp, p, x, k_pages, v_pages, step
+        )
+        last = jnp.clip(length - 1, 0, S - 1)[:, None, None]
+        x_last = jnp.take_along_axis(x, jnp.broadcast_to(
+            last, (x.shape[0], 1, x.shape[2])), axis=1)
+        logits = decomp.head(p, x_last)[0, 0]  # [vocab]
+        return logits, k_pages, v_pages
+
+    return prefill_fn
+
+
+# ---------------------------------------------------------------------------
+# program specs, fingerprints, compiles
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServeProgramSpec:
+    """One compilable serving program: the function, its ABSTRACT
+    arguments (lowerable without allocating a single real array — the
+    warm tool never touches device memory), the output shardings, and
+    the registry fingerprint."""
+
+    name: str                      # "init" | "decode" | "prefill-<S>"
+    fn: Callable
+    args: tuple                    # ShapeDtypeStructs (or () for init)
+    out_shardings: Optional[tuple]
+    program_fp: str
+    init_options: bool             # init compiler effort vs serving default
+    treedef: Any = None            # init only: unflatten spec for params
+
+
+def _fp(kind: str, family: str, cfg: TransformerConfig,
+        scfg: ResolvedServeConfig, extra: tuple = ()) -> str:
+    """Registry key material for one serving program: a pure function of
+    the model + serve SHAPE (dataclass reprs are deterministic), NOT of
+    the process — every host derives the same fingerprint, and
+    :func:`..registry.env_key` layers the compile environment on top.
+
+    Only fields the COMPILED program depends on enter its hash: the
+    programs never read ``max_new_tokens`` (a host-side budget), and the
+    init program does not depend on the serve shape at all — hashing
+    either would silently invalidate warmed artifacts on changes that
+    leave the compiled bytes identical (the init program is the most
+    expensive compile in the set)."""
+    shape = () if kind == "init" else (
+        scfg.max_batch, scfg.page_size, scfg.n_pages,
+        scfg.max_pages_per_seq, scfg.prefill_buckets,
+    )
+    h = hashlib.sha1(b"tdx-serve-program-fp-v1")
+    h.update(repr((kind, family, cfg, shape, extra)).encode())
+    return h.hexdigest()
+
+
+def _mesh_desc(mesh) -> str:
+    if mesh is None:
+        return "none"
+    return repr(sorted((str(k), int(v)) for k, v in mesh.shape.items()))
+
+
+def _abstract_params(family, cfg, *, seed, sample_len, param_dtype,
+                     mesh, plan):
+    """(init run_fn, init out_shardings, params treedef, abstract params
+    pytree) — the deferred-init thunk and the ShapeDtypeStruct tree the
+    prefill/decode programs are lowered against (cast policy and planned
+    shardings applied, so the lowered signature matches the arrays the
+    init program will actually deliver)."""
+    model = make_model(family, cfg)
+    sample = jnp.zeros((1, sample_len), jnp.int32)
+    fakes = abstract.deferred_init(
+        model.init, jax.random.PRNGKey(seed), sample
+    )
+    run_fn, out_shardings, treedef = abstract.materialize_parts(
+        fakes, mesh=mesh, plan=plan, param_dtype=param_dtype
+    )
+    leaves = jax.tree.leaves(fakes, is_leaf=abstract.is_fake)
+    sds = []
+    for i, f in enumerate(leaves):
+        dt = f.dtype
+        if param_dtype is not None and abstract._cast_eligible(f, f._thunk):
+            dt = param_dtype
+        if out_shardings is not None:
+            sds.append(jax.ShapeDtypeStruct(f.shape, dt,
+                                            sharding=out_shardings[i]))
+        else:
+            sds.append(jax.ShapeDtypeStruct(f.shape, dt))
+    params_abs = jax.tree.unflatten(treedef, sds)
+    return run_fn, out_shardings, treedef, params_abs
+
+
+def serve_program_specs(
+    family: str,
+    cfg: TransformerConfig,
+    serve_cfg: Optional[ServeConfig] = None,
+    *,
+    seed: int = 0,
+    param_dtype=None,
+    mesh=None,
+    plan=None,
+    sample_len: int = 8,
+    include_init: bool = True,
+    buckets: Optional[Tuple[int, ...]] = None,
+) -> List[ServeProgramSpec]:
+    """Every program a replica of this shape compiles, in bring-up order
+    (init, prefill buckets, decode).  ``tools/warm_cache.py --decode``
+    compiles exactly this list; the engine compiles members of it on
+    demand — same builders, same fingerprints, so a warmed registry
+    makes bring-up all-hit."""
+    scfg = (serve_cfg or ServeConfig()).resolve(cfg)
+    run_fn, out_shardings, treedef, params_abs = _abstract_params(
+        family, cfg, seed=seed, sample_len=sample_len,
+        param_dtype=param_dtype, mesh=mesh, plan=plan,
+    )
+    kv = scfg.kv_config(cfg)
+    pool_sds = jax.ShapeDtypeStruct(kv.pool_shape(), cfg.dtype)
+    i32 = jnp.int32
+    B, maxp = scfg.max_batch, scfg.max_pages_per_seq
+    # The OUTPUT CONTRACT is part of every fingerprint, exactly as the
+    # torch path's _registry_program_fp hashes str(NamedSharding) per
+    # slot: two plans with the same class name but different rules must
+    # never collide on one registry key — the params' shardings shape
+    # the init program's outputs AND the prefill/decode programs'
+    # lowered input signatures.
+    shard_desc = (
+        "none" if out_shardings is None
+        else ";".join(str(s) for s in out_shardings)
+    )
+    extra = (seed, sample_len, str(param_dtype), _mesh_desc(mesh),
+             shard_desc)
+
+    specs: List[ServeProgramSpec] = []
+    if include_init:
+        specs.append(ServeProgramSpec(
+            name="init", fn=run_fn, args=(),
+            out_shardings=out_shardings,
+            program_fp=_fp("init", family, cfg, scfg, extra),
+            init_options=True, treedef=treedef,
+        ))
+    for b in (buckets if buckets is not None else scfg.prefill_buckets):
+        specs.append(ServeProgramSpec(
+            name=f"prefill-{b}",
+            fn=build_prefill_fn(family, cfg, scfg, b),
+            args=(params_abs, pool_sds, pool_sds,
+                  jax.ShapeDtypeStruct((1, b), i32),
+                  jax.ShapeDtypeStruct((1,), i32),
+                  jax.ShapeDtypeStruct((1, maxp), i32)),
+            out_shardings=None,
+            program_fp=_fp(f"prefill-{b}", family, cfg, scfg, extra),
+            init_options=False,
+        ))
+    specs.append(ServeProgramSpec(
+        name="decode",
+        fn=build_decode_fn(family, cfg, scfg),
+        args=(params_abs, pool_sds, pool_sds,
+              jax.ShapeDtypeStruct((B,), i32),
+              jax.ShapeDtypeStruct((B,), i32),
+              jax.ShapeDtypeStruct((B, maxp), i32)),
+        out_shardings=None,
+        program_fp=_fp("decode", family, cfg, scfg, extra),
+        init_options=False,
+    ))
+    return specs
+
+
+def compile_serving_program(spec: ServeProgramSpec):
+    """Compile one serving program through the materialization engines'
+    `_compile_program` — persistent cache, artifact registry
+    fetch→verify→install / publish, exact cache-outcome counters, chaos
+    sites, and the ``TDX_COMPILE_DEADLINE_S`` watchdog all included.
+    Returns ``(compiled, cache_outcome)``."""
+    from ..jax_bridge import materialize as mat
+
+    mat._maybe_enable_cache()
+    cfg = tdx_config.get()
+    with observe.span(
+        "serve.compile", category="serve", program=spec.name
+    ) as sp:
+        compiled, t_lower, t_compile, outcome = mat._compile_program(
+            spec.fn, tuple(spec.args), spec.out_shardings,
+            fault_plan=chaos.active_plan(),
+            deadline=cfg.compile_deadline_s or None,
+            program_fp=spec.program_fp,
+            init_compiler_options=spec.init_options,
+        )
+        sp.set(cache=outcome, lower_s=round(t_lower, 4),
+               compile_s=round(t_compile, 4))
+    return compiled, outcome
+
+
+# ---------------------------------------------------------------------------
+# decode-program warming (tools/warm_cache.py --decode)
+# ---------------------------------------------------------------------------
+
+
+def warm_serving(
+    family: str,
+    cfg: TransformerConfig,
+    cache_dir: str,
+    *,
+    registry_dir: Optional[str] = None,
+    serve_cfg: Optional[ServeConfig] = None,
+    seed: int = 0,
+    param_dtype=None,
+    mesh=None,
+    plan=None,
+    sample_len: int = 8,
+) -> dict:
+    """Warm a replica shape's WHOLE program set — init, every prefill
+    bucket, decode — into ``cache_dir`` (and publish to ``registry_dir``
+    when set), so a later :func:`..serve.engine.spin_up_replica` of the
+    same shape performs zero local compiles.  Returns the same summary
+    shape as :func:`..registry.warm_sharded` (per-program outcome
+    reports; ``unwarmed`` non-empty on any failure)."""
+    from ..jax_bridge import materialize as mat
+    from ..registry.scheduler import ProgramReport
+
+    t0 = time.perf_counter()
+    log = get_logger()
+    reports: List[ProgramReport] = []
+    with tdx_config.override(
+        cache_dir=cache_dir, registry_dir=registry_dir or None
+    ):
+        mat._reset_cache_binding()
+        mat._maybe_enable_cache()
+        try:
+            specs = serve_program_specs(
+                family, cfg, serve_cfg, seed=seed, param_dtype=param_dtype,
+                mesh=mesh, plan=plan, sample_len=sample_len,
+            )
+            for spec in specs:
+                t = time.perf_counter()
+                fetches_before = observe.counter(
+                    "tdx.registry.fetch_hit").value
+                try:
+                    _, outcome = compile_serving_program(spec)
+                except Exception as e:  # noqa: BLE001 — report, keep warming
+                    log.error("warm-serving: program %s failed (%s: %s)",
+                              spec.name, type(e).__name__, str(e)[:160])
+                    reports.append(ProgramReport(
+                        program=spec.name, outputs=1, outcome="unwarmed",
+                        seconds=time.perf_counter() - t,
+                        error=f"{type(e).__name__}: {str(e)[:200]}",
+                    ))
+                    continue
+                from ..registry import ArtifactRegistry, registry_key
+                from ..registry.scheduler import classify_warm_outcome
+
+                label = classify_warm_outcome(
+                    outcome,
+                    fetched=(observe.counter("tdx.registry.fetch_hit").value
+                             > fetches_before),
+                    published=bool(
+                        registry_dir
+                        and ArtifactRegistry(registry_dir).has(
+                            registry_key(spec.program_fp))
+                    ),
+                )
+                reports.append(ProgramReport(
+                    program=spec.name, outputs=1, outcome=label,
+                    seconds=time.perf_counter() - t, cache=outcome,
+                ))
+        finally:
+            mat._reset_cache_binding()
+
+    outcomes: Dict[str, int] = {}
+    for r in reports:
+        outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+    import os
+
+    try:
+        cache_entries = len(os.listdir(cache_dir))
+    except OSError:
+        cache_entries = 0
+    return {
+        "programs": sum(1 for r in reports if r.outcome != "unwarmed"),
+        "outputs": sum(r.outputs for r in reports
+                       if r.outcome != "unwarmed"),
+        "cache_entries": cache_entries,
+        "seconds": round(time.perf_counter() - t0, 2),
+        "backend": jax.default_backend(),
+        "cache_dir": cache_dir,
+        "registry_dir": registry_dir,
+        "hosts": 1,
+        "host_id": 0,
+        "decode": True,
+        "outcomes": outcomes,
+        "program_reports": [r.as_dict() for r in reports],
+        "unwarmed": [r.program for r in reports if r.outcome == "unwarmed"],
+    }
