@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -47,11 +47,22 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke serve-smoke
+chaos-test: registry-smoke serve-smoke obs-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py \
+	    tests/test_flightrec.py \
 	    -q -p no:cacheprovider
+
+# Observability smoke (docs/observability.md §Flight recorder): an
+# injected compile hang (watchdog-killed), an exhausted materialization
+# ladder, a chaos serve fault, and an uncaught exception must each leave
+# a schema-valid flight-recorder dump under TDX_FLIGHT_DIR that
+# tools/tdx_trace.py renders (flight + fleet), with the periodic
+# exporter writing %h-expanded metrics throughout.  CPU, bounded; part
+# of `make chaos-test`.
+obs-smoke:
+	timeout -k 10 420 bash scripts/obs_smoke.sh
 
 # Serving smoke (docs/serving.md): decode-program warm into a shared
 # artifact registry, then a fresh-process replica bring-up with an

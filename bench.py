@@ -237,6 +237,13 @@ def _phase_ours(model_cls, config, param_dtype=None) -> dict:
         warm=warm, backend=jax.default_backend(),
     )
     n_bytes = sum(int(v.size) * v.dtype.itemsize for v in params.values())
+    # Measured link bandwidth (probed AFTER the timed region — a few
+    # device_puts) turns the GB/s figure into a utilization fraction:
+    # the ROADMAP's 100×-gap headline with a real denominator.
+    from torchdistx_tpu.observe import costmodel
+
+    link_gbps = costmodel.link_bandwidth_gbps()
+    gbps = n_bytes / t / 1e9
     return {
         "t": t,
         "record_s": round(t_record, 3),
@@ -250,7 +257,18 @@ def _phase_ours(model_cls, config, param_dtype=None) -> dict:
         # timed region (conservative: the region also includes the
         # touch reduction) — the materialize-throughput figure the
         # charter's single-chip judging asks for.
-        "materialize_gbps": round(n_bytes / t / 1e9, 3),
+        "materialize_gbps": round(gbps, 3),
+        **({
+            "link_bandwidth_gbps": round(link_gbps, 3),
+            "materialize_link_utilization": round(gbps / link_gbps, 5),
+        } if link_gbps else {}),
+        # Compiler-reported accounting for the init program(s): measured
+        # FLOPs and the largest single-program device footprint
+        # (observe.costmodel via materialize.last_run_stats).
+        **({"materialize_xla_gflops": round(stats["xla_flops"] / 1e9, 3)}
+           if stats.get("xla_flops") else {}),
+        **({"materialize_peak_hbm_mb": round(stats["xla_peak_bytes"] / 1e6, 1)}
+           if stats.get("xla_peak_bytes") else {}),
         **({
             "materialize_mode": stats.get("mode"),
             "materialize_n_programs": stats.get("n_programs"),
@@ -928,6 +946,33 @@ def phase_train_mfu() -> dict:
     }
     if peak is not None:
         out["mfu"] = round(flops / t / 1e12 / peak, 4)
+    # Compiler-derived complement to the analytic accounting above: AOT
+    # compile the SAME jitted step once (the persistent cache makes it a
+    # one-time cost per device kind) and read XLA's own FLOP count and
+    # peak device footprint.  XLA counts FLOPs the hardware RUNS: under
+    # remat that includes recompute, so mfu_xla is HFU-flavored and
+    # reads high vs the analytic mfu above (which excludes recompute by
+    # convention) — both are reported, neither replaces the other.
+    # mfu_xla uses measured FLOPs over the same
+    # measured step time — the number SimpleFSDP/veScale-style
+    # validation wants.  TDX_BENCH_XLA_COST=0 opts out.
+    if os.environ.get("TDX_BENCH_XLA_COST", "1") != "0":
+        try:
+            from torchdistx_tpu.observe import costmodel
+
+            compiled_step = train_step.lower(state, tokens).compile()
+            costs = costmodel.program_costs(compiled_step) or {}
+            if costs.get("flops"):
+                out["xla_flops_per_step"] = costs["flops"]
+                out["tflops_xla"] = round(costs["flops"] / t / 1e12, 2)
+                if peak is not None:
+                    out["mfu_xla"] = round(
+                        costs["flops"] / t / 1e12 / peak, 4
+                    )
+            if costs.get("peak_bytes"):
+                out["step_peak_hbm_mb"] = round(costs["peak_bytes"] / 1e6, 1)
+        except Exception as e:  # noqa: BLE001 — accounting is best-effort
+            out["xla_cost_error"] = f"{type(e).__name__}: {e}"[-120:]
     return out
 
 
@@ -1141,6 +1186,14 @@ def phase_serving() -> dict:
             out["decode_tokens_per_s"] = round(n_tok / dt, 2)
             out["storm_requests"] = len(reqs)
             out["storm_tokens"] = n_tok
+            # Measured latency percentiles over the storm (the SLO
+            # windows the engine feeds every tick — docs/observability.md
+            # §SLOs): what a fleet operator would page on.
+            out["slo"] = {
+                name: {k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in stats.items()}
+                for name, stats in eng.slo.snapshot().items()
+            }
             for r in reqs:
                 want, _ = oracle_generate("llama", cfg, eng.params,
                                           r.tokens, r.max_new_tokens)
@@ -1314,6 +1367,11 @@ _ENGINE_SPLIT_KEYS = (
     "materialize_mode", "materialize_n_programs", "materialize_lower_s",
     "materialize_compile_s", "materialize_execute_s", "materialize_overlap",
     "materialize_exec_gbps",
+    # Cost-model fields ride the same promote/rename machinery: a
+    # CPU-fresh link utilization must never sit unrenamed next to a
+    # promoted hardware headline.
+    "link_bandwidth_gbps", "materialize_link_utilization",
+    "materialize_xla_gflops", "materialize_peak_hbm_mb",
 )
 
 PHASES = {
@@ -1717,6 +1775,10 @@ def main() -> None:
             })
             if c_ours["result"].get("materialize_gbps") is not None:
                 out["materialize_gbps"] = c_ours["result"]["materialize_gbps"]
+            for k in ("materialize_link_utilization", "link_bandwidth_gbps",
+                      "materialize_xla_gflops", "materialize_peak_hbm_mb"):
+                if c_ours["result"].get(k) is not None:
+                    out[k] = c_ours["result"][k]
             if abs(c_ours["ts"] - c_base["ts"]) > 300:
                 out["headline_mixed_sessions"] = True
         # Off-accelerator the 1.9B phase measures XLA CPU compile and the
@@ -1923,8 +1985,8 @@ _HEADLINE_KEYS = (
     "metric", "value", "unit", "vs_baseline", "platform", "baseline_s",
     "warm_compile_cache", "headline_from_cache", "headline_age_s",
     "headline_cache_expired_s",
-    "materialize_gbps", "pipeline_speedup",
-    "train_mfu", "train_tokens_per_s", "train_step_ms",
+    "materialize_gbps", "materialize_link_utilization", "pipeline_speedup",
+    "train_mfu", "train_mfu_xla", "train_tokens_per_s", "train_step_ms",
     "train_stale_s", "train_mfu_skipped", "train_mfu_error",
     "flash_mfu", "flash_speedup", "flash_bwd_mfu", "flash_bwd_speedup",
     "flash_bias_mfu", "flash_bias_speedup", "flash_stale_s",

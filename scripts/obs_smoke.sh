@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Observability smoke (make obs-smoke, docs/observability.md): prove the
+# forensics layer end to end on CPU — an injected compile hang killed by
+# the watchdog, a terminal MaterializationError, a chaos fault in the
+# serve loop, and an uncaught exception must EACH leave a flight-recorder
+# dump under TDX_FLIGHT_DIR that schema-validates and that
+# tools/tdx_trace.py can render (flight + fleet), while the periodic
+# exporter writes live %h-expanded metrics the whole time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TDX_CACHE_MIN_COMPILE_S=0
+
+TMP=$(mktemp -d /tmp/tdx_obs_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+FLIGHT="$TMP/flight/%h"
+
+echo "== 1. watchdog-killed compile hang leaves a dump, run still succeeds =="
+TDX_FLIGHT_DIR="$FLIGHT" TDX_FAULT_PLAN='compile@1=hang:30' \
+TDX_COMPILE_DEADLINE_S=2 TDX_MATERIALIZE_PIPELINE=off python - <<'EOF'
+import torch
+from torchdistx_tpu.deferred_init import deferred_init
+from torchdistx_tpu.jax_bridge import materialize_module_jax
+
+params = materialize_module_jax(deferred_init(torch.nn.Linear, 8, 4))
+assert set(params) == {"weight", "bias"}
+print("  materialize survived the injected hang (watchdog + retry)")
+EOF
+
+echo "== 2. exhausted ladder -> MaterializationError dump =="
+TDX_FLIGHT_DIR="$FLIGHT" TDX_FAULT_PLAN='compile@1=raise x9' \
+TDX_MATERIALIZE_RETRIES=1 TDX_MATERIALIZE_PIPELINE=off python - <<'EOF'
+import torch
+from torchdistx_tpu.deferred_init import deferred_init
+from torchdistx_tpu.jax_bridge import materialize_module_jax
+from torchdistx_tpu.jax_bridge.materialize import MaterializationError
+
+try:
+    materialize_module_jax(deferred_init(torch.nn.Linear, 8, 4))
+except MaterializationError as e:
+    print(f"  MaterializationError as expected: {str(e)[:60]}...")
+else:
+    raise SystemExit("expected MaterializationError")
+EOF
+
+echo "== 3. chaos serve fault mid-batch leaves a dump, outputs stay oracle-equal =="
+TDX_FLIGHT_DIR="$FLIGHT" TDX_FAULT_PLAN='serve@2=raise' \
+TDX_METRICS_EXPORT_S=0.2 TDX_METRICS_PATH="$TMP/flight/%h/metrics.prom" \
+TDX_CACHE_DIR="$TMP/serve_cache" python - <<'EOF'
+import time
+from torchdistx_tpu.serve import (
+    Request, ServeConfig, oracle_generate, spin_up_replica,
+)
+
+scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                   max_pages_per_seq=4, prefill_buckets=(8,))
+eng = spin_up_replica("tiny", serve_cfg=scfg)
+reqs = [Request(f"r{i}", [3 + i, 7, 11], max_new_tokens=4) for i in range(3)]
+out = eng.run(reqs)
+for r in reqs:
+    want, _ = oracle_generate(eng.family, eng.cfg, eng.params,
+                              r.tokens, r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, out[r.rid], want)
+slo = eng.slo.snapshot()
+assert "ttft" in slo and "token" in slo, slo
+time.sleep(0.5)  # let the periodic exporter fire at least once
+print(f"  {len(reqs)} requests == oracle through the fault; "
+      f"SLO p50 TTFT {slo['ttft']['p50']*1e3:.1f}ms")
+EOF
+
+echo "== 4. uncaught exception -> excepthook dump =="
+set +e
+TDX_FLIGHT_DIR="$FLIGHT" python - <<'EOF' 2>/dev/null
+from torchdistx_tpu import observe
+
+observe.counter("tdx.smoke.arm").inc()  # first emission arms the hooks
+raise RuntimeError("obs-smoke: deliberately uncaught")
+EOF
+rc=$?
+set -e
+test "$rc" -ne 0  # the exception must still kill the process
+
+echo "== 5. dumps schema-validate and render (flight + fleet + summary) =="
+HOSTDIR=$(dirname "$(ls "$TMP"/flight/*/flight-*.json | head -1)")
+python - "$HOSTDIR" <<'EOF'
+import glob, json, sys
+reasons = set()
+for p in glob.glob(sys.argv[1] + "/flight-*.json"):
+    doc = json.load(open(p))
+    for k in ("schema", "reason", "events", "config", "env",
+              "counter_snapshots", "host", "pid", "time"):
+        assert k in doc, (p, k)
+    reasons.add(doc["reason"])
+need = {"compile_watchdog_kill", "materialization_error", "serve_fault",
+        "unhandled_exception", "chaos_injected"}
+missing = need - reasons
+assert not missing, f"missing dump reasons: {missing} (have {reasons})"
+print(f"  {len(reasons)} distinct dump reasons, all schema-valid")
+EOF
+python tools/tdx_trace.py flight "$HOSTDIR" > "$TMP/flight.txt"
+grep -q "compile_watchdog_kill" "$TMP/flight.txt"
+grep -q "unhandled_exception" "$TMP/flight.txt"
+python tools/tdx_trace.py fleet "$TMP/flight" > "$TMP/fleet.txt"
+grep -q "flight dumps by reason" "$TMP/fleet.txt"
+grep -q "serve_fault" "$TMP/fleet.txt"
+test -s "$HOSTDIR/metrics.prom"
+grep -q "tdx_serve_slo_ttft_p50_s" "$HOSTDIR/metrics.prom"
+sed -n '1,12p' "$TMP/fleet.txt" | sed 's/^/  /'
+
+echo "obs-smoke OK"

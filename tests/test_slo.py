@@ -1,0 +1,251 @@
+"""Serve SLOs (torchdistx_tpu.observe.slo): sliding-window percentile
+math (exact, time- and count-bounded), ServeSLO gauge publication, the
+periodic metrics-exporter thread (interval, atomic .prom rewrite, %h/%p
+expansion, flight counter snapshots), and the Prometheus exporter edge
+cases the fleet scrape path depends on (label escaping, NaN/±Inf
+gauges, +Inf bucket / _sum / _count consistency, torn-free histogram
+snapshots under concurrent writers)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import torchdistx_tpu.config as tdx_config
+from torchdistx_tpu import observe
+from torchdistx_tpu.observe import slo
+from torchdistx_tpu.observe.metrics import Counters
+
+
+@pytest.fixture()
+def telemetry():
+    observe.reset()
+    observe.enable(True)
+    try:
+        yield observe
+    finally:
+        slo.stop_exporter()
+        observe.enable(None)
+        observe.reset()
+
+
+class TestSloWindow:
+    def test_exact_percentiles(self):
+        w = slo.SloWindow()
+        for v in range(1, 101):  # 0.01 .. 1.00
+            w.observe(v / 100)
+        pct = w.percentiles((50, 95, 99))
+        assert pct == {50: 0.50, 95: 0.95, 99: 0.99}
+        assert w.count() == 100
+
+    def test_empty_window_is_none(self):
+        assert slo.SloWindow().percentiles() is None
+
+    def test_time_ageout(self):
+        w = slo.SloWindow(window_s=10.0)
+        w.observe(1.0, now=0.0)
+        w.observe(2.0, now=9.0)
+        # At t=15 the t=0 sample is outside the 10 s window.
+        assert w.percentiles((50,), now=15.0) == {50: 2.0}
+        assert w.count(now=25.0) == 0
+
+    def test_count_bound(self):
+        w = slo.SloWindow(max_samples=8)
+        for v in range(100):
+            w.observe(float(v))
+        assert w.count() <= 8
+        # The retained tail is the most recent samples.
+        assert w.percentiles((50,))[50] >= 92.0
+
+    def test_weighted_samples(self):
+        w = slo.SloWindow()
+        w.observe(0.001, n=9)  # one 9-wide decode tick
+        w.observe(1.0)
+        assert w.count() == 10
+        pct = w.percentiles((50, 99))
+        assert pct[50] == 0.001
+        assert pct[99] == 1.0
+
+    def test_nearest_rank_median_odd_n(self):
+        w = slo.SloWindow()
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            w.observe(v)
+        # ceil nearest-rank: the median of 5 samples is the 3rd —
+        # round() would give the 2nd.
+        assert w.percentiles((50,))[50] == 3.0
+
+    def test_single_sample(self):
+        w = slo.SloWindow()
+        w.observe(0.25)
+        assert w.percentiles((50, 95, 99)) == {50: 0.25, 95: 0.25, 99: 0.25}
+
+
+class TestServeSLO:
+    def test_publish_gauges(self, telemetry):
+        s = slo.ServeSLO()
+        for i in range(20):
+            s.observe_ttft(0.01 * (i + 1))
+            s.observe_token_latency(0.002)
+        s.observe_queue_wait(0.5)
+        snap = s.publish()
+        assert set(snap) == {"ttft", "token", "queue_wait"}
+        g = {r["name"]: r["value"] for r in observe.counters().snapshot()}
+        assert g["tdx.serve.slo.ttft_p50_s"] == pytest.approx(0.10, abs=0.011)
+        assert g["tdx.serve.slo.token_p99_s"] == pytest.approx(0.002)
+        assert g["tdx.serve.slo.queue_wait_p95_s"] == pytest.approx(0.5)
+        assert g["tdx.serve.slo.ttft_window_count"] == 20
+
+    def test_stale_window_poisons_gauges(self, telemetry):
+        import math
+
+        s = slo.ServeSLO(window_s=0.05)
+        s.observe_ttft(0.1)
+        s.publish()
+        g = {r["name"]: r["value"] for r in observe.counters().snapshot()}
+        assert g["tdx.serve.slo.ttft_p50_s"] == pytest.approx(0.1)
+        time.sleep(0.12)
+        # The window aged out: the periodic exporter must not keep
+        # presenting the old p50 as the current window.
+        s.publish()
+        g = {r["name"]: r["value"] for r in observe.counters().snapshot()}
+        assert math.isnan(g["tdx.serve.slo.ttft_p50_s"])
+        assert g["tdx.serve.slo.ttft_window_count"] == 0
+
+
+class TestExporter:
+    def test_periodic_prom_export(self, telemetry, tmp_path):
+        mp = str(tmp_path / "m-%p.prom")
+        observe.counter("tdx.exp.c").inc(3)
+        s = slo.ServeSLO()
+        s.observe_ttft(0.05)
+        with tdx_config.override(metrics_export_s=0.05, metrics_path=mp):
+            ex = slo.ensure_exporter(s)
+            assert ex is not None
+            deadline = time.time() + 5.0
+            want = tdx_config.expand_path(mp)
+            while time.time() < deadline and ex.exports < 2:
+                time.sleep(0.02)
+        slo.stop_exporter()
+        assert ex.exports >= 2
+        text = open(want).read()
+        assert "tdx_exp_c 3" in text
+        assert "tdx_serve_slo_ttft_p50_s" in text
+        # The exporter also fed the flight recorder's snapshot history.
+        from torchdistx_tpu.observe import flightrec
+
+        assert flightrec._counter_snaps
+
+    def test_disabled_without_interval(self, telemetry):
+        assert slo.ensure_exporter() is None
+
+    def test_jsonl_append_mode(self, telemetry, tmp_path):
+        mp = str(tmp_path / "m.jsonl")
+        observe.counter("tdx.exp.j").inc()
+        with tdx_config.override(metrics_export_s=0.05, metrics_path=mp):
+            ex = slo.ensure_exporter()
+            deadline = time.time() + 5.0
+            while time.time() < deadline and ex.exports < 2:
+                time.sleep(0.02)
+        slo.stop_exporter()
+        recs = [json.loads(line) for line in open(mp)]
+        assert sum(1 for r in recs if r["name"] == "tdx.exp.j") >= 2
+
+
+class TestPrometheusEdgeCases:
+    def test_label_value_escaping(self):
+        c = Counters()
+        c.counter("tdx.e", kind='he said "hi"\\here\nthere').inc()
+        text = c.to_prometheus()
+        assert 'kind="he said \\"hi\\"\\\\here\\nthere"' in text
+        # Parseable shape: exactly one sample line for the metric.
+        assert sum(1 for line in text.splitlines()
+                   if line.startswith("tdx_e{")) == 1
+
+    def test_nan_and_inf_gauges(self):
+        c = Counters()
+        c.gauge("tdx.g.nan").set(float("nan"))
+        c.gauge("tdx.g.pinf").set(float("inf"))
+        c.gauge("tdx.g.ninf").set(float("-inf"))
+        c.gauge("tdx.g.unset")  # never set: value is None
+        lines = c.to_prometheus().splitlines()
+        by = {line.rsplit(" ", 1)[0]: line.rsplit(" ", 1)[1]
+              for line in lines if not line.startswith("#")}
+        assert by["tdx_g_nan"] == "NaN"
+        assert by["tdx_g_pinf"] == "+Inf"
+        assert by["tdx_g_ninf"] == "-Inf"
+        assert by["tdx_g_unset"] == "NaN"
+
+    def test_histogram_inf_bucket_sum_count_consistency(self):
+        c = Counters()
+        h = c.histogram("tdx.h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = c.to_prometheus()
+        lines = [line for line in text.splitlines() if line.startswith("tdx_h")]
+        # Cumulative le buckets ending in +Inf == _count; _sum is the
+        # exact total.
+        assert 'tdx_h_bucket{le="0.1"} 1' in lines
+        assert 'tdx_h_bucket{le="1.0"} 2' in lines
+        assert 'tdx_h_bucket{le="+Inf"} 4' in lines
+        assert "tdx_h_sum 55.55" in text
+        assert "tdx_h_count 4" in text
+
+    def test_snapshot_never_tears_under_writers(self):
+        """sum(buckets) == count and sum-of-values consistency must hold
+        in EVERY snapshot taken while writer threads hammer the
+        histogram — the regression shape for the unlocked-read bug."""
+        c = Counters()
+        h = c.histogram("tdx.h.torn", buckets=(1.0,))
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                h.observe(0.5)
+                h.observe(2.0)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.time() + 1.0
+            checked = 0
+            while time.time() < deadline:
+                (rec,) = [r for r in c.snapshot()
+                          if r["name"] == "tdx.h.torn"]
+                assert sum(rec["buckets"].values()) == rec["count"], rec
+                # Every pair of observations adds exactly 2.5: a torn
+                # (count, sum) pair shows up as a fractional residue.
+                lo = rec["buckets"]["1.0"]
+                hi = rec["buckets"]["+Inf"]
+                assert rec["sum"] == pytest.approx(0.5 * lo + 2.0 * hi)
+                checked += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert checked > 10  # the loop really exercised concurrent reads
+
+    def test_prom_file_atomic_rewrite(self, telemetry, tmp_path):
+        """The exporter's .prom rewrite goes through tmp+rename: the
+        published file never holds a partial exposition."""
+        mp = tmp_path / "atomic.prom"
+        observe.counter("tdx.a").inc()
+        with tdx_config.override(metrics_export_s=0.05,
+                                 metrics_path=str(mp)):
+            ex = slo.ensure_exporter()
+            deadline = time.time() + 5.0
+            ok = 0
+            while time.time() < deadline and ok < 20:
+                if mp.exists():
+                    text = mp.read_text()
+                    if text:
+                        assert text.endswith("\n")
+                        assert text.startswith("# TYPE")
+                        ok += 1
+                time.sleep(0.01)
+        slo.stop_exporter()
+        assert ok >= 20

@@ -10,30 +10,57 @@ Commands:
 
 ``summary <dir-or-file>... [--top N]``
     Human-readable digest of one run: wall span, top span names by
-    aggregate self-time, compile-cache hit ratio, platform-fallback and
-    verification-failure counts, final counter/gauge values.
+    aggregate self-time, compile-cache hit ratio, dropped-event count,
+    serve SLO percentiles, platform-fallback and verification-failure
+    counts, final counter/gauge values.
 
 ``chrome <dir-or-file>... [-o merged.json]``
     Merge every per-process trace into ONE Chrome-trace JSON loadable in
     ``chrome://tracing`` / Perfetto (timestamps are epoch-anchored, so
     processes land on a shared timeline).
 
-Exit status: 0 on success, 2 when no trace events were found.
+``flight <dump-or-dir>...``
+    Render flight-recorder post-mortem dumps (TDX_FLIGHT_DIR bundles):
+    schema-validate each, then print reason/time/context, the final
+    counter snapshot, and the last spans leading up to the trigger.
+    Exit 1 on schema violations.
+
+``fleet <dir>... [--top N]``
+    Roll per-host telemetry dirs (traces + flight dumps + ``%h``/pid
+    metrics files) into ONE report: per-host compile/fetch/steal counts,
+    flight-dump reasons, slowest spans, and fleet-wide totals with serve
+    SLO percentiles.  Each argument dir is one host; a single argument
+    whose subdirectories hold the telemetry expands to one host per
+    subdir (the natural layout for ``TDX_FLIGHT_DIR=/logs/%h``).
+
+Exit status: 0 on success, 2 when no telemetry was found.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
 import sys
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# Mirror of torchdistx_tpu.observe.flightrec.SCHEMA_KEYS — this CLI must
+# stay importable with stdlib only (login hosts without torch/jax), so
+# it carries its own copy; keep the two in sync.
+FLIGHT_SCHEMA_VERSION = 1
+FLIGHT_SCHEMA_KEYS = (
+    "schema", "reason", "time", "pid", "host", "events", "config",
+    "env", "counter_snapshots",
+)
 
 
 def iter_trace_files(paths: List[str]) -> Iterator[str]:
     for p in paths:
         if os.path.isdir(p):
             for name in sorted(os.listdir(p)):
+                if name.startswith("flight-"):
+                    continue  # post-mortem bundles: the `flight`/`fleet` cmds
                 if name.endswith(".trace.json") or name.endswith(".json"):
                     yield os.path.join(p, name)
         else:
@@ -59,7 +86,8 @@ def _final_counters(events: List[dict]) -> Dict[str, float]:
     """Counters are per-process cumulative totals: take the LATEST sample
     (by timestamp — file order is not time order across flushes) of each
     (name, pid) stream, then sum over pids so a multi-process run
-    aggregates correctly."""
+    aggregates correctly.  Percentile gauges (``.slo.`` streams) take the
+    max instead — a p99 summed over processes is not a p99."""
     last: Dict[tuple, tuple] = {}
     for e in events:
         if e.get("ph") != "C":
@@ -73,11 +101,48 @@ def _final_counters(events: List[dict]) -> Dict[str, float]:
         key = (e.get("name"), e.get("pid"))
         ts = float(e.get("ts", 0.0))
         if key not in last or ts >= last[key][0]:
-            last[key] = (ts, float(value))
+            last[key] = (ts, float(value), args.get("mtype"))
     out: Dict[str, float] = {}
-    for (name, _pid), (_ts, v) in last.items():
-        out[name] = out.get(name, 0.0) + v
+    for (name, _pid), (_ts, v, mtype) in last.items():
+        if v != v:
+            continue  # NaN-poisoned gauge (aged-out window): not a value
+        if (mtype == "gauge" and _gauge_takes_max(name or "")) \
+                or (mtype is None and ".slo." in (name or "")):
+            # Singleton gauges (percentiles, link bandwidth, high-water
+            # marks) take max over pids — summed they are nonsense; the
+            # remaining gauges are per-replica rates/capacities where
+            # fleet totals ARE the sum.  Pre-mtype trace files fall
+            # back to the .slo. name heuristic.
+            out[name] = max(out.get(name, 0.0), v)
+        else:
+            out[name] = out.get(name, 0.0) + v
     return out
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None or v != v:  # NaN: a poisoned (aged-out) gauge
+        return "-"
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _slo_digest(counters: Dict[str, float], indent: str = "  ") -> List[str]:
+    """Serve SLO percentile lines from the exported gauges, or []."""
+    rows = []
+    for label, key in (("TTFT", "ttft"), ("per-token", "token"),
+                       ("queue wait", "queue_wait")):
+        ps = {q: _cg(counters, f"tdx.serve.slo.{key}_p{q}_s")
+              for q in (50, 95, 99)}
+        ps = {q: (None if v is not None and v != v else v)  # NaN → absent
+              for q, v in ps.items()}
+        if all(v is None for v in ps.values()):
+            continue
+        n = _cg(counters, f"tdx.serve.slo.{key}_window_count")
+        rows.append(
+            f"{indent}{label:<11} p50={_fmt_s(ps[50])} "
+            f"p95={_fmt_s(ps[95])} p99={_fmt_s(ps[99])}"
+            + (f"  (n={int(n)})" if n else "")
+        )
+    return ["serve SLOs (sliding window):"] + rows if rows else []
 
 
 def summarize(events: List[dict], top: int = 15) -> str:
@@ -153,6 +218,33 @@ def summarize(events: List[dict], top: int = 15) -> str:
         parts.append(f"{mb_f:.1f} MB fetched / {mb_p:.1f} MB published")
         lines.append(", ".join(parts))
 
+    # Silent span loss made loud: events evicted from the in-memory
+    # export buffer (tdx.observe.dropped_events counts them live; the
+    # tdx.trace.events_dropped stamp rides each flushed file).
+    dropped = max(
+        counters.get("tdx.observe.dropped_events", 0.0),
+        counters.get("tdx.trace.events_dropped", 0.0),
+    )
+    if dropped:
+        lines.append(
+            f"WARNING: {int(dropped)} trace event(s) dropped from the "
+            f"export buffer (raise the tracer cap or flush more often; "
+            f"the flight recorder's ring is unaffected)"
+        )
+
+    slo_lines = _slo_digest(counters)
+    if slo_lines:
+        lines.append("")
+        lines.extend(slo_lines)
+
+    dumps = sum(
+        v for k, v in counters.items()
+        if k.startswith("tdx.observe.flight_dumps")
+        and "suppressed" not in k
+    )
+    if dumps:
+        lines.append(f"flight-recorder dumps: {int(dumps)}")
+
     # Counter preferred; the instant events are the same occurrences
     # (counting both would double), and only the exact platform event
     # qualifies — bench.cache_fallback is a different condition.
@@ -206,6 +298,375 @@ def merge_chrome(events: List[dict]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+# -- flight-recorder dumps ---------------------------------------------------
+
+
+def find_flight_dumps(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                _glob.glob(os.path.join(p, "flight-*.json"))
+                + _glob.glob(os.path.join(p, "**", "flight-*.json"),
+                             recursive=True)
+            ))
+        elif os.path.basename(p).startswith("flight-"):
+            out.append(p)
+    # de-dup while keeping order (the two globs overlap on depth-1 dirs)
+    seen: set = set()
+    return [p for p in out if not (p in seen or seen.add(p))]
+
+
+def validate_flight(doc: dict) -> List[str]:
+    """Stdlib mirror of observe.flightrec.validate (keep in sync)."""
+    problems = [f"missing key {k!r}" for k in FLIGHT_SCHEMA_KEYS
+                if k not in doc]
+    if doc.get("schema") not in (FLIGHT_SCHEMA_VERSION,):
+        problems.append(f"unknown schema version {doc.get('schema')!r}")
+    if not isinstance(doc.get("events"), list):
+        problems.append("events is not a list")
+    return problems
+
+
+def _flight_counters(doc: dict) -> Dict[str, float]:
+    """Final counter values carried by a dump (its last snapshot)."""
+    snaps = doc.get("counter_snapshots") or []
+    out: Dict[str, float] = {}
+    if snaps:
+        for rec in snaps[-1].get("counters", []):
+            v = rec.get("value", rec.get("count"))
+            if isinstance(v, (int, float)):
+                name = rec["name"]
+                if rec.get("labels"):
+                    name += "{" + ",".join(
+                        f"{k}={v2}" for k, v2 in sorted(rec["labels"].items())
+                    ) + "}"
+                out[name] = float(v)
+    return out
+
+
+def render_flight(path: str, doc: dict, top: int = 8) -> str:
+    import datetime
+
+    lines = [f"== {path}"]
+    problems = validate_flight(doc)
+    if problems:
+        lines.append("  SCHEMA INVALID: " + "; ".join(problems))
+        return "\n".join(lines)
+    ts = datetime.datetime.fromtimestamp(doc["time"]).isoformat(
+        sep=" ", timespec="seconds")
+    lines.append(
+        f"  reason: {doc['reason']}   at {ts}   "
+        f"host={doc['host']} pid={doc['pid']}"
+    )
+    ctx = doc.get("context") or {}
+    if ctx:
+        lines.append("  context: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(ctx.items())))
+    events = doc["events"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    lines.append(
+        f"  ring: {len(events)} events ({len(spans)} spans, "
+        f"{len(instants)} instants)"
+        + (f", {doc['dropped_events']} dropped upstream"
+           if doc.get("dropped_events") else "")
+    )
+    if spans:
+        lines.append(f"  last {min(top, len(spans))} spans before the trigger:")
+        for e in spans[-top:]:
+            dur = e.get("dur", 0.0) / 1e6
+            attrs = e.get("args") or {}
+            extra = ", ".join(
+                f"{k}={v}" for k, v in attrs.items()
+                if k in ("cache", "group", "error", "step", "rid")
+            )
+            lines.append(
+                f"    {e.get('name', '?'):<28} {dur:>9.3f}s"
+                + (f"  [{extra}]" if extra else "")
+            )
+    counters = _flight_counters(doc)
+    interesting = {k: v for k, v in sorted(counters.items())
+                   if v and not k.startswith("tdx.observe.flight_dumps")}
+    if interesting:
+        lines.append("  final counters:")
+        for k, v in list(interesting.items())[:14]:
+            vs = f"{int(v)}" if v == int(v) else f"{v:.3f}"
+            lines.append(f"    {k:<40} {vs}")
+    return "\n".join(lines)
+
+
+# -- fleet rollup ------------------------------------------------------------
+
+# Gauges where max-over-processes is the honest rollup: percentiles,
+# measured link bandwidth, high-water marks, per-step figures — summing
+# any of these across pids is nonsense (3 processes probing one link is
+# not 3x the bandwidth).  The REMAINING gauges are per-replica
+# rates/capacities (tokens_per_s, queue_depth, kv_pages_in_use) where
+# fleet totals ARE the sum, like counters.
+_GAUGE_MAX_PREFIXES = (
+    "tdx.serve.slo.", "tdx.jax.link_", "tdx.jax.hbm_high_water",
+    "tdx.jax.materialize_gbps", "tdx.train.mfu", "tdx.train.step_ms",
+    "tdx.train.tflops",
+)
+
+
+def _gauge_takes_max(name: str) -> bool:
+    base = name.split("{", 1)[0]
+    return any(base.startswith(p) or base.startswith(_prom_name(p))
+               for p in _GAUGE_MAX_PREFIXES)
+
+
+def _load_one_metrics_file(path: str) -> Tuple[Dict[str, float],
+                                               Dict[str, str]]:
+    """One exported metrics file → ({name: value}, {base_name: type}).
+    Within one file last-write-wins is correct (a process re-exports its
+    own totals); aggregation across files happens in the caller."""
+    out: Dict[str, float] = {}
+    types: Dict[str, str] = {}
+    last_ts: Dict[str, float] = {}
+    if path.endswith(".prom"):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("# TYPE "):
+                    parts = line.split()
+                    if len(parts) == 4:
+                        types[parts[2]] = parts[3]
+                    continue
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.rsplit(" ", 1)
+                if len(parts) != 2:
+                    continue
+                try:
+                    out[parts[0]] = float(parts[1])
+                except ValueError:
+                    continue
+    else:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                name = rec.get("name")
+                v = rec.get("value", rec.get("count"))
+                if name is None or not isinstance(v, (int, float)):
+                    continue
+                if rec.get("type"):
+                    types[name] = rec["type"]
+                if rec.get("labels"):
+                    # Labeled streams must stay distinct (and keyed like
+                    # the trace/flight spellings, so _canon_key dedupes
+                    # instead of the bare name double-counting).
+                    name += "{" + ",".join(
+                        f"{k}={v2}" for k, v2 in
+                        sorted(rec["labels"].items())
+                    ) + "}"
+                ts = float(rec.get("ts", 0.0))
+                if ts >= last_ts.get(name, -1.0):
+                    last_ts[name] = ts
+                    out[name] = float(v)
+    return out, types
+
+
+def _load_metrics_files(host_dir: str) -> Dict[str, float]:
+    """Final counter values from exported metrics files under one host
+    dir (names arrive sanitized from .prom — stored as-is; lookups go
+    through _ck).  With ``%p`` templating one host dir holds one file
+    PER PROCESS: counters/histograms sum across files, gauges follow
+    :func:`_gauge_takes_max` — last-write-wins across pids would keep
+    one arbitrary process and drop the rest."""
+    out: Dict[str, float] = {}
+    for path in sorted(
+        _glob.glob(os.path.join(host_dir, "*.jsonl"))
+        + _glob.glob(os.path.join(host_dir, "*.prom"))
+    ):
+        try:
+            vals, types = _load_one_metrics_file(path)
+        except OSError as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        for name, v in vals.items():
+            if v != v:
+                continue  # NaN-poisoned gauge: not a value
+            base = name.split("{", 1)[0]
+            if name not in out:
+                out[name] = v
+            elif types.get(base) == "gauge" and _gauge_takes_max(name):
+                out[name] = max(out[name], v)
+            else:
+                out[name] = out[name] + v
+    return out
+
+
+def _prom_name(name: str) -> str:
+    import re
+
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _canon_key(key: str) -> str:
+    """Canonical counter key: Prometheus-sanitized metric name, label
+    values unquoted.  Trace/flight sources carry ``tdx.chaos.injected
+    {kind=raise}`` while .prom exports carry ``tdx_chaos_injected
+    {kind="raise"}`` — canonicalizing BOTH at merge time lets
+    ``setdefault`` dedupe the same stream across source formats (else
+    ``_ck`` would sum the two spellings and double-count)."""
+    name, sep, rest = key.partition("{")
+    return _prom_name(name) + ((sep + rest.replace('"', "")) if sep else "")
+
+
+def _cg(counters: Dict[str, float], name: str) -> Optional[float]:
+    """Single-value lookup tolerant of Prometheus-sanitized names;
+    None when absent (``_ck`` coerces to 0 and sums labels)."""
+    v = counters.get(name)
+    return v if v is not None else counters.get(_prom_name(name))
+
+
+def _ck(counters: Dict[str, float], name: str) -> float:
+    """Counter lookup tolerant of Prometheus-sanitized names (and of
+    labeled streams: ``name{...}`` variants are summed in).  Assumes
+    label keys are canonical (``_canon_key``) OR come from a single
+    source format — never both spellings of one stream."""
+    base = _cg(counters, name) or 0.0
+    dotted, sanitized = name + "{", _prom_name(name) + "{"
+    labeled = sum(
+        val for key, val in counters.items()
+        if key.startswith(dotted)
+        or (sanitized != dotted and key.startswith(sanitized))
+    )
+    return base + labeled
+
+
+def _expand_hosts(paths: List[str]) -> List[Tuple[str, str]]:
+    """(host_name, dir) pairs.  Each arg dir is a host; a SINGLE arg dir
+    with no telemetry of its own but telemetry-bearing subdirs expands
+    to one host per subdir (the ``/logs/%h`` layout)."""
+    def has_telemetry(d: str) -> bool:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        return any(
+            n.endswith((".trace.json", ".prom", ".jsonl"))
+            or n.startswith("flight-")
+            for n in names
+        )
+
+    if len(paths) == 1 and os.path.isdir(paths[0]) and not has_telemetry(paths[0]):
+        subs = [
+            (n, os.path.join(paths[0], n))
+            for n in sorted(os.listdir(paths[0]))
+            if os.path.isdir(os.path.join(paths[0], n))
+        ]
+        subs = [(n, d) for n, d in subs if has_telemetry(d)]
+        if subs:
+            return subs
+    return [(os.path.basename(os.path.normpath(p)) or p, p) for p in paths]
+
+
+def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
+    """The multi-host rollup; returns (text, n_sources)."""
+    hosts = _expand_hosts(paths)
+    lines: List[str] = []
+    totals: Dict[str, float] = {}
+    n_sources = 0
+    rows = []
+    slo_sections: List[str] = []
+    for host, d in hosts:
+        events = load_events([d]) if os.path.isdir(d) else []
+        dumps = []
+        for p in find_flight_dumps([d]):
+            try:
+                with open(p) as f:
+                    dumps.append(json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"warning: skipping {p}: {e}", file=sys.stderr)
+        counters = {
+            _canon_key(k): v for k, v in _final_counters(events).items()
+        }
+        # Fill gaps from exported metrics files, then flight snapshots
+        # (trace-final values win: they are flushed last); canonical
+        # keys make the dedupe hold across source formats.
+        for src in (_load_metrics_files(d) if os.path.isdir(d) else {},
+                    *map(_flight_counters, dumps)):
+            for k, v in src.items():
+                counters.setdefault(_canon_key(k), v)
+        if not events and not dumps and not counters:
+            continue
+        n_sources += 1
+        spans = [e for e in events if e.get("ph") == "X"]
+        slowest = sorted(spans, key=lambda e: -e.get("dur", 0.0))[:top]
+        reasons: Dict[str, int] = {}
+        for doc in dumps:
+            r = doc.get("reason", "?")
+            reasons[r] = reasons.get(r, 0) + 1
+        row = {
+            "host": host,
+            "spans": len(spans),
+            "hit": _ck(counters, "tdx.jax.compile_cache_hit"),
+            "miss": _ck(counters, "tdx.jax.compile_cache_miss"),
+            "fetch": _ck(counters, "tdx.registry.fetch_hit"),
+            "steal": _ck(counters, "tdx.registry.steals"),
+            "chaos": _ck(counters, "tdx.chaos.injected"),
+            "dumps": len(dumps),
+            "reasons": reasons,
+            "slowest": slowest,
+        }
+        rows.append(row)
+        for k in ("hit", "miss", "fetch", "steal", "chaos"):
+            totals[k] = totals.get(k, 0.0) + row[k]
+        totals["dumps"] = totals.get("dumps", 0.0) + len(dumps)
+        host_slo = _slo_digest(counters, indent="    ")
+        if host_slo:
+            slo_sections.append(f"  {host}:")
+            slo_sections.extend(host_slo[1:])
+    if not rows:
+        return "", 0
+    lines.append(f"fleet: {len(rows)} host(s)")
+    lines.append("")
+    lines.append(
+        f"  {'host':<16} {'spans':>6} {'c.hit':>6} {'c.miss':>6} "
+        f"{'r.fetch':>7} {'steals':>6} {'chaos':>6} {'dumps':>6}"
+    )
+    for r in rows:
+        lines.append(
+            f"  {r['host']:<16} {r['spans']:>6} {int(r['hit']):>6} "
+            f"{int(r['miss']):>6} {int(r['fetch']):>7} {int(r['steal']):>6} "
+            f"{int(r['chaos']):>6} {r['dumps']:>6}"
+        )
+    lines.append(
+        f"  {'TOTAL':<16} {'':>6} {int(totals.get('hit', 0)):>6} "
+        f"{int(totals.get('miss', 0)):>6} {int(totals.get('fetch', 0)):>7} "
+        f"{int(totals.get('steal', 0)):>6} {int(totals.get('chaos', 0)):>6} "
+        f"{int(totals.get('dumps', 0)):>6}"
+    )
+    dump_rows = [r for r in rows if r["reasons"]]
+    if dump_rows:
+        lines.append("")
+        lines.append("flight dumps by reason:")
+        for r in dump_rows:
+            body = ", ".join(f"{k}×{v}" for k, v in sorted(r["reasons"].items()))
+            lines.append(f"  {r['host']:<16} {body}")
+    if slo_sections:
+        lines.append("")
+        lines.append("serve SLOs per host (sliding window):")
+        lines.extend(slo_sections)
+    slow_rows = [(r["host"], e) for r in rows for e in r["slowest"]]
+    slow_rows.sort(key=lambda he: -he[1].get("dur", 0.0))
+    if slow_rows:
+        lines.append("")
+        lines.append(f"slowest spans fleet-wide (top {top} per host):")
+        for host, e in slow_rows[: 3 * top]:
+            lines.append(
+                f"  {host:<16} {e.get('name', '?'):<28} "
+                f"{e.get('dur', 0.0) / 1e6:>9.3f}s"
+            )
+    return "\n".join(lines), n_sources
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tdx_trace", description=__doc__,
@@ -219,7 +680,43 @@ def main(argv=None) -> int:
     pc.add_argument("paths", nargs="+")
     pc.add_argument("-o", "--output", default=None,
                     help="output file (default: stdout)")
+    pf = sub.add_parser("flight", help="render flight-recorder dumps")
+    pf.add_argument("paths", nargs="+")
+    pf.add_argument("--top", type=int, default=8,
+                    help="spans shown per dump")
+    pl = sub.add_parser("fleet", help="roll per-host telemetry dirs up")
+    pl.add_argument("paths", nargs="+")
+    pl.add_argument("--top", type=int, default=3,
+                    help="slowest spans per host")
     args = ap.parse_args(argv)
+
+    if args.cmd == "flight":
+        dump_paths = find_flight_dumps(args.paths)
+        if not dump_paths:
+            print("no flight dumps found", file=sys.stderr)
+            return 2
+        bad = 0
+        for path in dump_paths:
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"== {path}\n  UNREADABLE: {e}")
+                bad += 1
+                continue
+            if validate_flight(doc):
+                bad += 1
+            print(render_flight(path, doc, top=args.top))
+        print(f"{len(dump_paths)} dump(s), {bad} invalid")
+        return 1 if bad else 0
+
+    if args.cmd == "fleet":
+        text, n = fleet_report(args.paths, top=args.top)
+        if not n:
+            print("no telemetry found", file=sys.stderr)
+            return 2
+        print(text)
+        return 0
 
     events = load_events(args.paths)
     if not events:

@@ -83,6 +83,13 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
         "chaos.injected", category="chaos",
         spec=fault.spec(), **({"path": str(path)} if path else {}),
     )
+    # Before acting: a raise/preempt may unwind or kill the process, and
+    # the post-mortem must show the state AT injection, not after the
+    # recovery rewrote it (no-op without TDX_FLIGHT_DIR; throttled).
+    observe.flight_dump(
+        "chaos_injected", spec=fault.spec(),
+        **({"path": str(path)} if path else {}),
+    )
     log.warning("chaos: injecting %s%s", fault.spec(),
                 f" (path={path})" if path else "")
 

@@ -68,7 +68,27 @@ Environment variables (read at first import):
                         process exit ("" disables).
 ``TDX_METRICS_PATH``    File for the telemetry counter registry: Prometheus
                         text format if the path ends in ``.prom``, JSON
-                        lines otherwise ("" disables).
+                        lines otherwise ("" disables).  ``%h``/``%p`` in the
+                        path expand to hostname/pid at write time (opt-in:
+                        paths without the tokens are used verbatim), so
+                        concurrent hosts and subprocesses of one run cannot
+                        clobber each other's file — ``tools/tdx_trace.py
+                        fleet`` merges the per-host/per-pid results back.
+``TDX_FLIGHT_DIR``      Directory for flight-recorder post-mortem dumps
+                        (:mod:`torchdistx_tpu.observe.flightrec`): when set,
+                        an always-on bounded ring of recent telemetry events
+                        is kept per process and dumped atomically there on
+                        watchdog kills, materialization failures, chaos
+                        injections, serve faults, SIGTERM drains, and
+                        unhandled exceptions ("" disables).  ``%h``/``%p``
+                        expand like ``TDX_METRICS_PATH``.
+``TDX_METRICS_EXPORT_S``
+                        Period (seconds) of the background metrics-exporter
+                        thread: when > 0, the counter registry (and the
+                        serve SLO percentile gauges) are re-exported to
+                        ``TDX_METRICS_PATH`` every interval, so a fleet
+                        scraper sees live values instead of exit-time ones
+                        (0 disables; see docs/observability.md).
 ``TDX_FAULT_PLAN``      Deterministic fault-injection plan for the elastic
                         training stack (:mod:`torchdistx_tpu.chaos`), e.g.
                         ``"step@4=raise;save@2=corrupt:truncate"``
@@ -89,7 +109,7 @@ import threading
 from dataclasses import dataclass, replace
 from typing import Iterator, Optional
 
-__all__ = ["Config", "bind", "get", "override", "set_flags"]
+__all__ = ["Config", "bind", "expand_path", "get", "override", "set_flags"]
 
 
 @dataclass(frozen=True)
@@ -101,6 +121,8 @@ class Config:
     log_level: str = "INFO"
     trace_dir: Optional[str] = None
     metrics_path: Optional[str] = None
+    flight_dir: Optional[str] = None
+    metrics_export_s: float = 0.0
     fault_plan: Optional[str] = None
     materialize_pipeline: str = "auto"
     compile_workers: int = 0
@@ -119,6 +141,8 @@ def _from_env() -> Config:
         log_level=os.environ.get("TDX_LOG_LEVEL", "INFO"),
         trace_dir=os.environ.get("TDX_TRACE_DIR", "") or None,
         metrics_path=os.environ.get("TDX_METRICS_PATH", "") or None,
+        flight_dir=os.environ.get("TDX_FLIGHT_DIR", "") or None,
+        metrics_export_s=float(os.environ.get("TDX_METRICS_EXPORT_S", "0")),
         fault_plan=os.environ.get("TDX_FAULT_PLAN", "") or None,
         materialize_pipeline=os.environ.get("TDX_MATERIALIZE_PIPELINE", "auto"),
         compile_workers=int(os.environ.get("TDX_COMPILE_WORKERS", "0")),
@@ -133,6 +157,25 @@ def _from_env() -> Config:
 _lock = threading.Lock()
 _base = _from_env()
 _tls = threading.local()
+
+
+def expand_path(path: Optional[str]) -> Optional[str]:
+    """Expand the multi-process template tokens in a telemetry path:
+    ``%h`` → short hostname, ``%p`` → pid.  Opt-in — a path without the
+    tokens is returned verbatim, so the single-process default behavior
+    (one file/dir) is unchanged.  Applied at WRITE time by
+    ``observe.flush`` / the metrics exporter / the flight recorder, so
+    one config value fans out correctly across hosts and subprocesses
+    (``tools/tdx_trace.py`` globs the results back together)."""
+    if not path or "%" not in path:
+        return path
+    if "%h" in path:
+        import socket
+
+        path = path.replace("%h", socket.gethostname().split(".")[0])
+    if "%p" in path:
+        path = path.replace("%p", str(os.getpid()))
+    return path
 
 
 def get() -> Config:

@@ -516,6 +516,13 @@ def _bounded_stage(stage: str, fn, *, deadline: Optional[float], group: int):
             "jax.compile_watchdog_kill", category="jax",
             stage=stage, group=group, deadline_s=deadline,
         )
+        # The evidence a post-mortem needs — which spans led up to the
+        # wedge — would evaporate if the process were killed next; the
+        # flight recorder persists it NOW (no-op without TDX_FLIGHT_DIR).
+        observe.flight_dump(
+            "compile_watchdog_kill", stage=stage, group=group,
+            deadline_s=deadline,
+        )
         raise CompileHangError(
             f"init-program {stage} of group {group} exceeded the "
             f"{deadline}s watchdog deadline (TDX_COMPILE_DEADLINE_S); "
@@ -711,7 +718,11 @@ def last_run_stats() -> Dict:
     programs), ``execute_s`` (monolithic: device execution; pipelined:
     dispatch plus the residual device wait not hidden behind compiles),
     ``wall_s``, ``overlap`` (busy/wall; >1 means phases genuinely
-    overlapped), and ``cache`` (outcome → count)."""
+    overlapped), ``cache`` (outcome → count), and — when the compiler
+    probes are available — ``xla_flops`` / ``xla_bytes_accessed``
+    (summed over programs) and ``xla_peak_bytes`` (largest
+    single-program device footprint), from
+    :func:`..observe.costmodel.program_costs`."""
     with _stats_lock:
         return dict(_last_run_stats)
 
@@ -722,12 +733,32 @@ def _set_run_stats(**kw) -> None:
         _last_run_stats.update(kw)
 
 
+def _cost_stats(costs: Dict) -> Dict:
+    """Fold one (or an accumulated) compiler cost record into run-stat
+    keys: ``xla_flops`` (summed over programs), ``xla_bytes_accessed``,
+    ``xla_peak_bytes`` (max single-program device footprint)."""
+    out: Dict = {}
+    if costs.get("flops"):
+        out["xla_flops"] = costs["flops"]
+    if costs.get("bytes_accessed"):
+        out["xla_bytes_accessed"] = costs["bytes_accessed"]
+    if costs.get("peak_bytes"):
+        out["xla_peak_bytes"] = costs["peak_bytes"]
+    return out
+
+
 def _compile_program(init_fn, key, out_shardings, label=None, *,
                      fault_plan=None, deadline=None, bypass_cache=False,
                      program_fp=None, jit_kwargs=None,
                      init_compiler_options=True):
     """jit → lower → compile ONE program; returns
-    ``(compiled, lower_s, compile_s, cache_outcome)``.  Safe to call from
+    ``(compiled, lower_s, compile_s, cache_outcome, costs)`` where
+    ``costs`` is the compiler-reported accounting
+    (:func:`..observe.costmodel.program_costs`: FLOPs, bytes accessed,
+    argument/output/temp/peak device bytes — None when the probes are
+    unavailable); the same record is attached to the ``jax.compile``
+    span, folded into the HBM high-water gauge, and published into the
+    registry manifest.  Safe to call from
     several threads at once — jax tracing is thread-local and the cache
     outcome is attributed through the monitoring record of whichever
     thread runs the compile (the watchdog may move it to an inner
@@ -854,6 +885,14 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
                 after = _persistent_cache_entries()
                 outcome = "miss" if (after != before or not before) else "hit"
         csp.set(cache=outcome)
+        # Compiler-reported accounting — probed unconditionally: the one
+        # call per program compile is noise next to the compile itself,
+        # and run stats / bench / the registry manifest consume the
+        # numbers even when tracing is off.
+        costs = observe.costmodel.program_costs(compiled)
+        if costs:
+            csp.set(**{f"xla_{k}": v for k, v in costs.items()})
+            observe.costmodel.note_program_memory(costs)
         if observe.enabled():
             observe.counter(f"tdx.jax.compile_cache_{outcome}").inc()
     if reg is not None and outcome in ("hit", "miss") and cache_keys and cdir:
@@ -867,13 +906,19 @@ def _compile_program(init_fn, key, out_shardings, label=None, *,
                 "registry-publish",
                 lambda: reg.publish_from_cache(
                     regkey, cdir, cache_keys, gno=gno, plan=fault_plan,
-                    meta={"program_fp": program_fp},
+                    meta={
+                        "program_fp": program_fp,
+                        # The manifest records what the compiler said this
+                        # program costs — a fleet can budget HBM/FLOPs for
+                        # a program it has never compiled locally.
+                        **({"xla_costs": costs} if costs else {}),
+                    },
                 ),
                 deadline=deadline, group=gno,
             )
         except CompileHangError:
             pass  # unpublished: some other host (or rerun) will
-    return compiled, t_lower, time.perf_counter() - t0, outcome
+    return compiled, t_lower, time.perf_counter() - t0, outcome, costs
 
 
 def _execute_compiled(compiled, key, gno, *, deadline, fault_plan,
@@ -920,7 +965,7 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
     t_wall = time.perf_counter()
 
     def _attempt(a):
-        compiled, t_lower, t_compile, outcome = _compile_program(
+        compiled, t_lower, t_compile, outcome, costs = _compile_program(
             init_fn, key, out_shardings, fault_plan=fault_plan,
             deadline=deadline,
             bypass_cache=(retries > 0 and a == retries),
@@ -949,10 +994,12 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
                 raise
             esp.block_on(out)
         jax.block_until_ready(out)
-        return out, t_lower, t_compile, time.perf_counter() - t0, outcome, a
+        return (out, t_lower, t_compile, time.perf_counter() - t0, outcome,
+                a, costs)
 
     try:
-        out, t_lower, t_compile, t_exec, outcome, attempts = _run_ladder(
+        (out, t_lower, t_compile, t_exec, outcome, attempts,
+         costs) = _run_ladder(
             _attempt, retries=retries, retryable=retryable,
             describe="monolithic program", bypass_note=True,
         )
@@ -969,6 +1016,7 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
         wall_s=time.perf_counter() - t_wall,
         overlap=1.0, cache={outcome: 1}, retries=attempts,
+        **(_cost_stats(costs) if costs else {}),
     )
     return out
 
@@ -1275,6 +1323,7 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
 
     t_wall = time.perf_counter()
     t_lower = t_compile = t_exec = 0.0
+    agg_costs: Dict[str, float] = {}
     failed: Dict[int, BaseException] = {}
     completed: set = set(resumed)
     try:
@@ -1306,7 +1355,7 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                         gi = futs[fut]
                         idxs = bins[gi]
                         try:
-                            compiled, tl, tc, outcome = fut.result()
+                            compiled, tl, tc, outcome, costs = fut.result()
                         except Exception as e:  # noqa: BLE001
                             if not isinstance(e, retryable):
                                 raise
@@ -1319,6 +1368,19 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                             continue
                         t_lower += tl
                         t_compile += tc
+                        if costs:
+                            # flops/bytes sum across programs; peak is the
+                            # largest single program (groups execute one at
+                            # a time per device at worst, concurrently at
+                            # best — max is the honest per-program figure).
+                            for k in ("flops", "bytes_accessed"):
+                                if costs.get(k):
+                                    agg_costs[k] = agg_costs.get(k, 0.0) + costs[k]
+                            if costs.get("peak_bytes"):
+                                agg_costs["peak_bytes"] = max(
+                                    agg_costs.get("peak_bytes", 0.0),
+                                    costs["peak_bytes"],
+                                )
                         outcomes[outcome] = outcomes.get(outcome, 0) + 1
                         t0 = time.perf_counter()
                         try:
@@ -1390,6 +1452,11 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
 
             if drain["requested"]:
                 drain_handled = True
+                observe.flight_dump(
+                    "sigterm_drain",
+                    completed_groups=sorted(completed), n_groups=len(bins),
+                    resumable=bool(rdir),
+                )
                 raise MaterializationError(
                     f"materialization drained on SIGTERM with "
                     f"{len(completed)}/{len(bins)} groups committed",
@@ -1455,6 +1522,7 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
         mode="pipelined", n_programs=len(bins), workers=workers,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
         wall_s=wall, overlap=round(overlap, 3), cache=outcomes,
+        **(_cost_stats(agg_costs) if agg_costs else {}),
     )
     return tuple(results)
 
@@ -1497,48 +1565,25 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
                 param_dtype, cast_mask,
             )
 
-        if bins is None:
-            init_fn = _cast_outputs(
-                build_init_fn(fake_list), param_dtype, cast_mask
+        try:
+            values = _run_engines(
+                fake_list, bins, key, out_shardings, seed, param_dtype,
+                cast_mask, fault_plan, _whole_fp,
             )
-            values = _run_init(init_fn, key, out_shardings,
-                               fault_plan=fault_plan,
-                               program_fp=_whole_fp())
-        else:
-            try:
-                values = _run_init_pipelined(
-                    fake_list, bins, key, out_shardings, param_dtype,
-                    cast_mask, seed=seed, fault_plan=fault_plan,
-                )
-            except MaterializationError as e:
-                if e.drained:
-                    raise  # preemption: no fallback, the progress is saved
-                observe.counter("tdx.jax.pipeline_fallbacks").inc()
-                observe.instant(
-                    "jax.pipeline_fallback", category="jax",
+        except MaterializationError as e:
+            # The whole ladder is spent and the error is about to escape
+            # to the application: persist the post-mortem ring now.  A
+            # SIGTERM drain already dumped (reason=sigterm_drain) inside
+            # the engine — don't double-report a survived preemption as
+            # a failure.
+            if not e.drained:
+                observe.flight_dump(
+                    "materialization_error", error=str(e)[:400],
                     failed_groups=list(e.failed_groups),
+                    completed_groups=list(e.completed_groups),
+                    resumable=e.resumable,
                 )
-                get_logger().error(
-                    "materialize: pipelined engine failed (%s); falling "
-                    "back to the monolithic program", e,
-                )
-                init_fn = _cast_outputs(
-                    build_init_fn(fake_list), param_dtype, cast_mask
-                )
-                try:
-                    values = _run_init(init_fn, key, out_shardings,
-                                       fault_plan=fault_plan,
-                                       program_fp=_whole_fp())
-                except MaterializationError as e2:
-                    # The whole ladder is spent; surface the pipelined
-                    # run's partial progress so a rerun can resume it.
-                    e2.completed_groups = e.completed_groups
-                    e2.failed_groups = e.failed_groups
-                    e2.resumable = e.resumable
-                    raise
-                rdir = config.get().materialize_resume_dir
-                if rdir:
-                    _clear_resume_state(rdir)  # monolith delivered it all
+            raise
         if observe.enabled():
             # Both engines block before returning, so this is a
             # bookkeeping pass, not a second sync.
@@ -1548,7 +1593,70 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
             sp.set(bytes=n_bytes, gbps=gbps)
             observe.counter("tdx.jax.bytes_materialized").inc(n_bytes)
             observe.gauge("tdx.jax.materialize_gbps").set(gbps)
+            # The ROADMAP's gap headline needs a denominator: report the
+            # achieved rate as a fraction of what this host→device link
+            # measures end to end.  Cached-only: probing HERE would run
+            # the device_puts inside the open span (and inside bench's
+            # timed region on the first call), skewing both — bench
+            # probes after its timed region, warming the cache.
+            lbw = observe.costmodel.link_bandwidth_gbps(cached_only=True)
+            if lbw:
+                util = gbps / lbw
+                sp.set(link_bandwidth_gbps=round(lbw, 3),
+                       link_utilization=util)
+                observe.gauge("tdx.jax.link_utilization").set(util)
     return values
+
+
+def _run_engines(fake_list, bins, key, out_shardings, seed, param_dtype,
+                 cast_mask, fault_plan, _whole_fp):
+    """Engine selection + the monolithic-fallback rung, extracted from
+    :func:`_materialize_values` so the failure-dump wrapper there reads
+    straight-line."""
+    from .. import config
+
+    if bins is None:
+        init_fn = _cast_outputs(
+            build_init_fn(fake_list), param_dtype, cast_mask
+        )
+        return _run_init(init_fn, key, out_shardings,
+                         fault_plan=fault_plan,
+                         program_fp=_whole_fp())
+    try:
+        return _run_init_pipelined(
+            fake_list, bins, key, out_shardings, param_dtype,
+            cast_mask, seed=seed, fault_plan=fault_plan,
+        )
+    except MaterializationError as e:
+        if e.drained:
+            raise  # preemption: no fallback, the progress is saved
+        observe.counter("tdx.jax.pipeline_fallbacks").inc()
+        observe.instant(
+            "jax.pipeline_fallback", category="jax",
+            failed_groups=list(e.failed_groups),
+        )
+        get_logger().error(
+            "materialize: pipelined engine failed (%s); falling "
+            "back to the monolithic program", e,
+        )
+        init_fn = _cast_outputs(
+            build_init_fn(fake_list), param_dtype, cast_mask
+        )
+        try:
+            values = _run_init(init_fn, key, out_shardings,
+                               fault_plan=fault_plan,
+                               program_fp=_whole_fp())
+        except MaterializationError as e2:
+            # The whole ladder is spent; surface the pipelined
+            # run's partial progress so a rerun can resume it.
+            e2.completed_groups = e.completed_groups
+            e2.failed_groups = e.failed_groups
+            e2.resumable = e.resumable
+            raise
+        rdir = config.get().materialize_resume_dir
+        if rdir:
+            _clear_resume_state(rdir)  # monolith delivered it all
+        return values
 
 
 def named_fake_tensors(module: torch.nn.Module) -> Dict[str, torch.Tensor]:

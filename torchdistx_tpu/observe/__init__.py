@@ -66,8 +66,9 @@ import os
 import threading
 from typing import Optional
 
+from . import costmodel, flightrec, slo
 from .metrics import Counter, Counters, Gauge, Histogram, JsonlSink
-from .spans import Span, Tracer, _NOOP_SPAN
+from .spans import Span, Tracer, _NOOP_SPAN, set_drop_hook, set_flight_feed
 from .step import StepMeter, peak_tflops_for
 
 __all__ = [
@@ -79,16 +80,20 @@ __all__ = [
     "Span",
     "StepMeter",
     "Tracer",
+    "costmodel",
     "counter",
     "counters",
     "enable",
     "enabled",
+    "flight_dump",
+    "flightrec",
     "flush",
     "gauge",
     "histogram",
     "instant",
     "peak_tflops_for",
     "reset",
+    "slo",
     "span",
     "tracer",
 ]
@@ -99,17 +104,25 @@ _COUNTERS = Counters(on_sample=lambda name, value: _TRACER.counter_sample(name, 
 _FORCED: Optional[bool] = None
 _flush_lock = threading.Lock()
 _autoflush_armed = False
+_flight_armed = False
 _last_counters_sig: Optional[str] = None
 _config = None  # cached module ref: enabled() sits on record_op's hot path
+
+# Silent span loss is now counted: every event the tracer's bounded
+# export buffer evicts increments tdx.observe.dropped_events, which the
+# exports (and tdx_trace.py summary) surface.
+set_drop_hook(
+    lambda n: _COUNTERS.counter("tdx.observe.dropped_events").inc(n)
+)
 
 
 def enabled() -> bool:
     """Whether telemetry is being collected.
 
     True when forced on via :func:`enable`, or when the effective config
-    (:func:`torchdistx_tpu.config.get`) carries a ``trace_dir`` or
-    ``metrics_path``.  This is THE gate every instrumentation point checks
-    first; keep it cheap."""
+    (:func:`torchdistx_tpu.config.get`) carries a ``trace_dir``,
+    ``metrics_path``, or ``flight_dir``.  This is THE gate every
+    instrumentation point checks first; keep it cheap."""
     if _FORCED is not None:
         return _FORCED
     global _config
@@ -118,7 +131,7 @@ def enabled() -> bool:
 
         _config = _config_mod
     cfg = _config.get()
-    return bool(cfg.trace_dir or cfg.metrics_path)
+    return bool(cfg.trace_dir or cfg.metrics_path or cfg.flight_dir)
 
 
 def enable(on: Optional[bool] = True) -> None:
@@ -195,8 +208,8 @@ def flush(
 
     global _last_counters_sig
     cfg = config.get()
-    td = trace_dir or cfg.trace_dir
-    mp = metrics_path or cfg.metrics_path
+    td = config.expand_path(trace_dir or cfg.trace_dir)
+    mp = config.expand_path(metrics_path or cfg.metrics_path)
     written: dict = {}
     with _flush_lock:
         counters_sig = repr(_COUNTERS.snapshot())
@@ -229,22 +242,46 @@ def flush(
     return written
 
 
+def flight_dump(reason: str, **context) -> Optional[str]:
+    """Dump a flight-recorder post-mortem bundle (no-op returning None
+    when no ``TDX_FLIGHT_DIR`` is configured) — the one call every
+    failure path makes; see :mod:`.flightrec`."""
+    if not flightrec.armed():
+        return None
+    return flightrec.dump(reason, **context)
+
+
 def reset() -> None:
     """Drop all collected events and metric values (tests)."""
     global _last_counters_sig
     _TRACER.clear()
     _COUNTERS.clear()
+    flightrec.clear()
     _last_counters_sig = None
 
 
 def _arm_autoflush() -> None:
     # Registered on the first emission, not at import: a process that
     # never records anything must not add an exit hook.
-    global _autoflush_armed
+    global _autoflush_armed, _flight_armed
+    if not _flight_armed and flightrec.armed():
+        # First emission under a bound flight dir: tee the tracer into
+        # the recorder's independent ring and install the
+        # unhandled-exception dumper.  The tee stays installed for the
+        # process (a ring fed outside an armed scope is just ignored —
+        # dump() re-checks the config).
+        _flight_armed = True
+        set_flight_feed(flightrec.feed)
+        flightrec.install_crash_hooks()
     if _autoflush_armed:
         return
     _autoflush_armed = True
     atexit.register(_atexit_flush)
+    # TDX_METRICS_EXPORT_S is a general knob, not a serving one: any
+    # telemetry-producing process (train, materialize) gets the
+    # periodic exporter on first emission (no-op when the knob is 0;
+    # ServeEngine re-calls to attach its SLO windows).
+    slo.ensure_exporter()
 
 
 def _atexit_flush() -> None:

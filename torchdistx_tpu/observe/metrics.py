@@ -77,15 +77,18 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value``; ``n`` > 1 records it as n identical samples
+        in one lock round-trip (a W-wide decode tick is W token
+        deliveries at the same latency)."""
         value = float(value)
         with self._lock:
             i = 0
             while i < len(self.buckets) and value > self.buckets[i]:
                 i += 1
-            self.counts[i] += 1
-            self.count += 1
-            self.sum += value
+            self.counts[i] += n
+            self.count += n
+            self.sum += value * n
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
 
@@ -132,7 +135,14 @@ class Counters:
     # -- export ----------------------------------------------------------
 
     def snapshot(self) -> List[dict]:
-        """Point-in-time records, one per metric (JSON-friendly)."""
+        """Point-in-time records, one per metric (JSON-friendly).
+
+        Each metric is read under ITS OWN lock, so a histogram observed
+        concurrently can never snapshot torn (count, sum, and the bucket
+        vector are copied atomically — Prometheus consumers rely on
+        ``sum(buckets) == count``); different metrics may still reflect
+        slightly different instants, which is inherent to any
+        multi-metric scrape."""
         with self._lock:
             metrics = list(self._metrics.values())
         out: List[dict] = []
@@ -141,16 +151,21 @@ class Counters:
             if m.labels:
                 rec["labels"] = dict(m.labels)
             if isinstance(m, Counter):
-                rec.update(type="counter", value=m.value)
+                with m._lock:
+                    rec.update(type="counter", value=m.value)
             elif isinstance(m, Gauge):
-                rec.update(type="gauge", value=m.value)
+                with m._lock:
+                    rec.update(type="gauge", value=m.value)
             else:
-                rec.update(
-                    type="histogram", count=m.count, sum=m.sum,
-                    min=m.min, max=m.max,
-                    buckets=dict(zip([str(b) for b in m.buckets] + ["+Inf"],
-                                     list(m.counts))),
-                )
+                with m._lock:
+                    rec.update(
+                        type="histogram", count=m.count, sum=m.sum,
+                        min=m.min, max=m.max,
+                        buckets=dict(zip(
+                            [str(b) for b in m.buckets] + ["+Inf"],
+                            list(m.counts),
+                        )),
+                    )
             out.append(rec)
         return out
 
@@ -189,10 +204,15 @@ class Counters:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def export_jsonl(self, path: str) -> None:
-        """Append one snapshot record per metric as JSON lines."""
+        """Append one snapshot record per metric as JSON lines (NaN →
+        null: bare ``NaN`` tokens are not JSON and break strict
+        parsers; Prometheus text keeps ``NaN``, which IS valid there)."""
         ts = time.time()
         with open(path, "a") as f:
             for rec in self.snapshot():
+                v = rec.get("value")
+                if isinstance(v, float) and v != v:
+                    rec = {**rec, "value": None}
                 f.write(json.dumps({"ts": ts, **rec}) + "\n")
 
 
@@ -200,11 +220,24 @@ def _prom_name(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+def _prom_escape(value: Any) -> str:
+    """Prometheus label-value escaping (text exposition format §label
+    values): backslash, double-quote, and newline must be escaped or a
+    value like ``He said "hi"\\n`` corrupts the whole exposition."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(labels: Optional[Dict[str, Any]]) -> str:
     if not labels:
         return ""
     body = ",".join(
-        f'{_prom_name(str(k))}="{str(v)}"' for k, v in sorted(labels.items())
+        f'{_prom_name(str(k))}="{_prom_escape(v)}"'
+        for k, v in sorted(labels.items())
     )
     return "{" + body + "}"
 
@@ -213,6 +246,12 @@ def _prom_num(value) -> str:
     if value is None:
         return "NaN"
     f = float(value)
+    if f != f:  # NaN: repr() would emit 'nan', which parsers reject
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
     return str(int(f)) if f == int(f) else repr(f)
 
 

@@ -28,6 +28,25 @@ _MAX_EVENTS = 200_000
 _EPOCH0 = time.time()
 _PERF0 = time.perf_counter()
 
+# Cross-module hooks, installed by torchdistx_tpu.observe (this module
+# stays import-cycle-free): `_flight_feed` tees every recorded event into
+# the flight recorder's independent ring when one is armed; `_drop_hook`
+# reports export-buffer evictions so silent span loss becomes the
+# `tdx.observe.dropped_events` counter.  Plain module globals read once
+# per record — None checks, no indirection cost when unused.
+_flight_feed = None
+_drop_hook = None
+
+
+def set_flight_feed(fn) -> None:
+    global _flight_feed
+    _flight_feed = fn
+
+
+def set_drop_hook(fn) -> None:
+    global _drop_hook
+    _drop_hook = fn
+
 
 def now_us() -> float:
     """Epoch-anchored monotonic timestamp in microseconds."""
@@ -140,9 +159,13 @@ class Tracer:
     def counter_sample(self, name: str, value: float) -> None:
         """A Chrome-trace counter ('C') sample — gauges call this on every
         ``set`` so they graph as time series in the trace viewer."""
+        if value != value:
+            # NaN (a poisoned gauge): json.dump would write a bare
+            # `NaN` token, which JSON.parse-based trace viewers reject.
+            return
         self._record({
             "name": name, "ph": "C", "ts": now_us(), "pid": _pid(),
-            "tid": _tid(), "args": {"value": value},
+            "tid": _tid(), "args": {"value": value, "mtype": "gauge"},
         })
 
     def _push(self, span: Span) -> None:
@@ -170,13 +193,21 @@ class Tracer:
         })
 
     def _record(self, event: dict) -> None:
+        dropped = False
         with self._lock:
             if (
                 self.events.maxlen is not None
                 and len(self.events) == self.events.maxlen
             ):
                 self.dropped += 1  # deque evicts the oldest on append
+                dropped = True
             self.events.append(event)
+        # Outside the tracer lock: the hooks take their own (counter)
+        # locks and must not nest under this one.
+        if dropped and _drop_hook is not None:
+            _drop_hook(1)
+        if _flight_feed is not None:
+            _flight_feed(event)
 
     # -- export ----------------------------------------------------------
 
@@ -204,9 +235,13 @@ class Tracer:
         if counters is not None:
             for rec in counters.snapshot():
                 if rec["type"] == "histogram":
-                    args = {"count": rec["count"], "sum": rec["sum"]}
+                    args = {"count": rec["count"], "sum": rec["sum"],
+                            "mtype": "histogram"}
                 else:
-                    args = {"value": rec["value"]}
+                    v = rec["value"]
+                    if isinstance(v, float) and v != v:
+                        v = None  # NaN is not valid JSON in a trace file
+                    args = {"value": v, "mtype": rec["type"]}
                 labels = rec.get("labels")
                 # Label sets become distinct counter names: two kinds of
                 # verify_failures must not collide into one last-write

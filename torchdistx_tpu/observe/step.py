@@ -49,7 +49,12 @@ class StepMeter:
       when telemetry is enabled;
     * ``tokens_per_step`` derives a ``tdx.train.tokens_per_s`` gauge;
     * ``flops_per_step`` (+ ``peak_tflops``) derive ``tdx.train.tflops``
-      and ``tdx.train.mfu_est`` gauges.
+      and an MFU gauge whose NAME declares its provenance:
+      ``tdx.train.mfu`` when ``flops_source="xla"`` (compiler-reported
+      FLOPs via :mod:`.costmodel` — the measured figure), or
+      ``tdx.train.mfu_est`` for the legacy 6·N·D estimate (the default,
+      so an uninstrumented caller can never mislabel a guess as
+      measured).
 
     Works with telemetry disabled too — it then times exactly like the old
     ``StepTimer`` and records nothing.
@@ -58,15 +63,19 @@ class StepMeter:
     def __init__(self, *, name: str = "train.step",
                  tokens_per_step: Optional[int] = None,
                  flops_per_step: Optional[float] = None,
-                 peak_tflops: Optional[float] = None):
+                 peak_tflops: Optional[float] = None,
+                 flops_source: str = "estimate"):
         self.name = name
         self.tokens_per_step = tokens_per_step
         self.flops_per_step = flops_per_step
         self.peak_tflops = peak_tflops
+        self.flops_source = flops_source
         self.steps = 0
         self.total = 0.0
         self._t0: Optional[float] = None
         self._span = None
+        self._gauges: dict = {}  # name → handle; registry lookups are
+        # lock + key-tuple work — once per gauge, not once per step
 
     def start(self) -> None:
         from . import enabled, tracer
@@ -88,9 +97,10 @@ class StepMeter:
         self.total += dt
         if self._span is not None:
             span, self._span = self._span, None
-            span.set(**self._derived(dt))
+            derived = self._derived(dt)
+            span.set(**derived)
             span.__exit__(None, None, None)
-            self._set_gauges(dt)
+            self._set_gauges(dt, derived)
         return dt
 
     def _derived(self, dt: float) -> dict:
@@ -99,17 +109,32 @@ class StepMeter:
             out["tokens_per_s"] = round(self.tokens_per_step / dt, 1)
         if self.flops_per_step:
             tflops = self.flops_per_step / dt / 1e12
-            out["tflops"] = round(tflops, 3)
+            # 6 decimals: a toy CPU step is micro-TFLOP/s and must not
+            # round to a 0.0 that reads as "no measurement".
+            out["tflops"] = round(tflops, 6)
             if self.peak_tflops:
-                out["mfu_est"] = round(tflops / self.peak_tflops, 4)
+                key = "mfu" if self.flops_source == "xla" else "mfu_est"
+                out[key] = round(tflops / self.peak_tflops, 4)
         return out
 
-    def _set_gauges(self, dt: float) -> None:
-        from . import gauge
+    def _set_gauges(self, dt: float, derived: dict) -> None:
+        self._gauge("tdx.train.step_ms").set(dt * 1e3)
+        for key, value in derived.items():
+            self._gauge(f"tdx.train.{key}").set(value)
+        if "mfu" not in derived and "tdx.train.mfu" in self._gauges:
+            # Provenance downgraded mid-run (e.g. the AOT probe fell
+            # back to the 6·N·D estimate): the periodic exporter would
+            # keep re-emitting the last measured value as if live —
+            # poison it to NaN (rendered as such) instead.
+            self._gauges.pop("tdx.train.mfu").set(float("nan"))
 
-        gauge("tdx.train.step_ms").set(dt * 1e3)
-        for key, value in self._derived(dt).items():
-            gauge(f"tdx.train.{key}").set(value)
+    def _gauge(self, name: str):
+        g = self._gauges.get(name)
+        if g is None:
+            from . import gauge
+
+            g = self._gauges[name] = gauge(name)
+        return g
 
     @property
     def mean(self) -> float:

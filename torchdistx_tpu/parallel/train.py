@@ -258,15 +258,23 @@ def train_elastic(
 
 def _instrument_step(step_fn, mesh: Mesh):
     """Per-step telemetry around a jitted train step: a ``train.step``
-    span plus ``tdx.train.tokens_per_s`` / ``tdx.train.mfu_est`` gauges,
-    via :class:`torchdistx_tpu.observe.StepMeter` (``StepTimer``'s
+    span plus ``tdx.train.tokens_per_s`` and MFU gauges, via
+    :class:`torchdistx_tpu.observe.StepMeter` (``StepTimer``'s
     successor).
 
     Each step blocks until ready so the span covers device work — that
     serializes dispatch, which is exactly why this wrapper only exists
-    when telemetry is enabled.  MFU is the 6·N·D parameter-matmul
-    estimate (attention term excluded), labeled ``_est`` accordingly;
-    bench.py's audited FLOP accounting remains the published number.
+    when telemetry is enabled.
+
+    FLOPs come from the COMPILER where possible: the first real call
+    AOT-compiles the step (``step_fn.lower(...).compile()`` — one
+    compile either way, since the compiled executable then serves every
+    step) and reads ``cost_analysis()``, so the published gauge is
+    ``tdx.train.mfu`` — measured work over measured time — and the
+    step's device footprint feeds the HBM high-water gauge.  When the
+    probe is unavailable (old jax, exotic backend) the meter falls back
+    to the 6·N·D parameter-matmul estimate under the honest
+    ``tdx.train.mfu_est`` name.
 
     The peak is the per-chip figure times the mesh size: flops_per_step
     is whole-model work executed across every mesh device, so the
@@ -277,6 +285,13 @@ def _instrument_step(step_fn, mesh: Mesh):
     peak = chip_peak * mesh.devices.size if chip_peak else None
     meter = observe.StepMeter(peak_tflops=peak)
     n_params = None
+    # Per-shape AOT cache (a compiled executable is shape-exact, and the
+    # jitted path it replaces caches every shape too — one slot would
+    # re-lower+compile on every step of an alternating bucket schedule).
+    # None records a failed probe so it is not retried per step.
+    aot_cache: dict = {}
+    _AOT_MAX_SHAPES = 8  # past this, new shapes just use the estimate
+    aot_dead = False  # an executable rejected its args: jit-only for good
 
     def wrapped(state, tokens, segment_ids=None):
         if not observe.enabled():
@@ -300,11 +315,53 @@ def _instrument_step(step_fn, mesh: Mesh):
             n_params = sum(
                 int(x.size) for x in jax.tree_util.tree_leaves(state["params"])
             )
+        args = (state, tokens) if segment_ids is None \
+            else (state, tokens, segment_ids)
+        nonlocal aot_dead
+        shape = (tuple(tokens.shape), str(tokens.dtype), segment_ids is None)
+        if (not aot_dead and shape not in aot_cache
+                and len(aot_cache) < _AOT_MAX_SHAPES):
+            ent = None
+            try:
+                compiled = step_fn.lower(*args).compile()
+                costs = observe.costmodel.program_costs(compiled)
+                # The executable is kept even without a FLOP count —
+                # the compile already happened; discarding it would
+                # make the jitted path pay it a second time.
+                ent = (compiled,
+                       costs.get("flops") if costs else None)
+                if costs:
+                    observe.costmodel.note_program_memory(costs)
+            except Exception:  # noqa: BLE001 — AOT probe is best-effort
+                pass
+            aot_cache[shape] = ent
+        ent = None if aot_dead else aot_cache.get(shape)
         ntok = int(tokens.shape[0]) * int(tokens.shape[1])
         meter.tokens_per_step = ntok
-        meter.flops_per_step = 6.0 * n_params * ntok
+        if ent is not None and ent[1]:
+            meter.flops_per_step = ent[1]
+            meter.flops_source = "xla"
+        else:
+            meter.flops_per_step = 6.0 * n_params * ntok
+            meter.flops_source = "estimate"
         meter.start()
-        out = step_fn(state, tokens, segment_ids)
+        try:
+            out = (ent[0](*args) if ent is not None
+                   else step_fn(state, tokens, segment_ids))
+        except (TypeError, ValueError):
+            # TypeError: shape/dtype mismatch; ValueError: jax's
+            # "Compiled object called with input sharding(s) does not
+            # match" — a sharding change the shape key can't see (e.g.
+            # after an elastic reshard).
+            if ent is None:
+                raise
+            # Fall back to the jitted path for good and keep the
+            # estimate provenance (a genuine user error re-raises from
+            # the jitted call below).
+            aot_dead = True
+            meter.flops_per_step = 6.0 * n_params * ntok
+            meter.flops_source = "estimate"
+            out = step_fn(state, tokens, segment_ids)
         meter.stop(out)
         return out
 

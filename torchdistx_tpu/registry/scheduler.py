@@ -215,7 +215,7 @@ def warm_sharded(factory, cache_dir: str, *,
         # is set: fetch→verify→install before the compile, publish after
         # — the same path the materialization engines run, including the
         # TDX_COMPILE_DEADLINE_S watchdog over compiles AND registry IO.
-        _, _tl, _tc, cache_outcome = mat._compile_program(
+        _, _tl, _tc, cache_outcome, _costs = mat._compile_program(
             fn, key, osh, label=spec.label,
             program_fp=spec.program_fp if reg is not None else None,
             deadline=tdx_config.get().compile_deadline_s or None,

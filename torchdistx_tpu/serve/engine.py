@@ -35,10 +35,17 @@ new replica's first token is gated by cache fetches, not XLA compiles
 (``make serve-smoke`` pins zero local compiles).
 
 Telemetry (docs/observability.md): ``tdx.serve.tokens_per_s``,
-``ttft_s`` (histogram), ``queue_depth``, ``kv_pages_in_use`` (from the
-allocator), ``preempted_requests``, plus ``requests_completed`` /
-``prefills`` / ``decode_steps`` counters and ``serve.step`` /
-``serve.prefill`` / ``serve.spin_up`` spans.
+``ttft_s`` / ``queue_wait_s`` / ``token_latency_s`` (histograms),
+``queue_depth``, ``kv_pages_in_use`` (from the allocator),
+``preempted_requests``, plus ``requests_completed`` / ``prefills`` /
+``decode_steps`` counters and ``serve.step`` / ``serve.prefill`` /
+``serve.spin_up`` spans.  SLOs (docs/observability.md §SLOs): every
+engine feeds sliding windows over TTFT, per-token latency, and queue
+wait (:class:`~torchdistx_tpu.observe.slo.ServeSLO`), published as
+``tdx.serve.slo.*_p{50,95,99}_s`` gauges — live via the periodic
+exporter when ``TDX_METRICS_EXPORT_S`` is set.  A step fault or a
+preemption also dumps the flight recorder (``TDX_FLIGHT_DIR``), so a
+replica that survived a fault leaves the evidence.
 """
 
 from __future__ import annotations
@@ -133,6 +140,20 @@ class ServeEngine:
         from ..jax_bridge.materialize import _retryable_errors
 
         self._retryable = _retryable_errors()
+        from ..observe import slo as _slo
+
+        self.slo = _slo.ServeSLO()
+        # Live percentile export for fleet scrapers; no-op unless
+        # TDX_METRICS_EXPORT_S > 0 (the first engine's SLO wins the
+        # exporter slot — one replica per process is the deployment
+        # shape).
+        _slo.ensure_exporter(self.slo)
+        # Handle resolved once: the registry lookup is lock + key-tuple
+        # work, and _decode_tick is the hot path.
+        self._tok_hist = observe.histogram(
+            "tdx.serve.token_latency_s",
+            buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+        )
 
     # -- program cache ------------------------------------------------------
 
@@ -266,6 +287,14 @@ class ServeEngine:
                 )
                 observe.instant("serve.fault", category="serve",
                                 step=self._step_no, error=type(e).__name__)
+                # Survived — but the post-mortem must not depend on the
+                # survival: persist the ring before the requeue rewrites
+                # the engine state (no-op without TDX_FLIGHT_DIR).
+                observe.flight_dump(
+                    "serve_fault", step=self._step_no,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                    active=len(self.active), waiting=len(self.waiting),
+                )
                 for slot in list(self.active):
                     self._preempt(slot, reason="fault")
         self._gauges()
@@ -294,6 +323,13 @@ class ServeEngine:
     def _prefill(self, req: Request, slot: int) -> None:
         L = len(req.tokens)
         bucket = self.scfg.bucket_for(L)
+        # Queue wait = submit → the moment a lane+pages were granted.
+        # A requeued (preempted/faulted) request measures from its
+        # ORIGINAL submit — the client has been waiting the whole time.
+        wait = time.perf_counter() - getattr(req, "_submit_t",
+                                             time.perf_counter())
+        observe.histogram("tdx.serve.queue_wait_s").observe(wait)
+        self.slo.observe_queue_wait(wait)
         sid = self._next_seq
         self._next_seq += 1
         self.kv.alloc(sid, L)
@@ -343,6 +379,7 @@ class ServeEngine:
             ttft = time.perf_counter() - getattr(req, "_submit_t",
                                                  time.perf_counter())
             observe.histogram("tdx.serve.ttft_s").observe(ttft)
+            self.slo.observe_ttft(ttft)
 
     # -- decode ---------------------------------------------------------------
 
@@ -373,6 +410,7 @@ class ServeEngine:
         self._ensure_capacity()
         if not self.active:
             return
+        t_step = time.perf_counter()
         B = self.scfg.max_batch
         maxp = self.scfg.max_pages_per_seq
         tokens = np.zeros((B,), np.int32)
@@ -388,6 +426,15 @@ class ServeEngine:
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(table),
         )
         logits = np.asarray(logits)
+        # Per-token latency: every lane's next token took this step's
+        # wall time (np.asarray above forced the device work) — one
+        # sample PER LANE, so the distribution weights a 4-wide step as
+        # the four token deliveries it was.
+        dt = time.perf_counter() - t_step
+        n_lanes = len(self.active)
+        if n_lanes:
+            self._tok_hist.observe(dt, n=n_lanes)
+            self.slo.observe_token_latency(dt, n=n_lanes)
         for slot in list(self.active):
             lane = self.active[slot]
             lane.length += 1
@@ -434,6 +481,14 @@ class ServeEngine:
         observe.instant("serve.preempt", category="serve",
                         rid=lane.req.rid, reason=reason,
                         step=self._step_no)
+        # Fault-driven preemptions already dumped at the step level with
+        # the full batch context; page-exhaustion preemptions dump here
+        # (throttled per reason inside the recorder).
+        if reason != "fault":
+            observe.flight_dump(
+                "serve_preempt", rid=lane.req.rid, preempt_reason=reason,
+                step=self._step_no, pages_in_use=self.kv.pages_in_use,
+            )
 
     # -- telemetry ----------------------------------------------------------
 
@@ -448,6 +503,12 @@ class ServeEngine:
                 observe.gauge("tdx.serve.tokens_per_s").set(
                     round(self._tokens_out / dt, 3)
                 )
+        # Percentile publication sorts the windows — cheap, but not
+        # per-tick cheap; refresh every 32 ticks and whenever the loop
+        # drains (the periodic exporter also republishes on its own
+        # clock regardless of tick rate).
+        if self._step_no % 32 == 0 or not (self.waiting or self.active):
+            self.slo.publish()
 
 
 # ---------------------------------------------------------------------------

@@ -497,7 +497,7 @@ def compile_serving_program(spec: ServeProgramSpec):
     with observe.span(
         "serve.compile", category="serve", program=spec.name
     ) as sp:
-        compiled, t_lower, t_compile, outcome = mat._compile_program(
+        compiled, t_lower, t_compile, outcome, costs = mat._compile_program(
             spec.fn, tuple(spec.args), spec.out_shardings,
             fault_plan=chaos.active_plan(),
             deadline=cfg.compile_deadline_s or None,
@@ -505,7 +505,8 @@ def compile_serving_program(spec: ServeProgramSpec):
             init_compiler_options=spec.init_options,
         )
         sp.set(cache=outcome, lower_s=round(t_lower, 4),
-               compile_s=round(t_compile, 4))
+               compile_s=round(t_compile, 4),
+               **({f"xla_{k}": v for k, v in costs.items()} if costs else {}))
     return compiled, outcome
 
 

@@ -617,6 +617,8 @@ def run_elastic(
             observe.counter("tdx.elastic.watchdog_kills").inc()
             observe.instant("elastic.watchdog_kill", category="elastic",
                             step=step_no, deadline_s=step_deadline)
+            observe.flight_dump("step_watchdog_kill", step=step_no,
+                                deadline_s=step_deadline)
             raise StepHangError(
                 f"step {step_no} exceeded the {step_deadline}s watchdog "
                 f"deadline; worker thread abandoned (a result that arrives "
@@ -640,6 +642,7 @@ def run_elastic(
         )
         observe.counter("tdx.elastic.drains").inc()
         observe.instant("elastic.drain", category="elastic", step=step)
+        observe.flight_dump("sigterm_drain", step=step)
         ok = True
         if checkpoint_dir is not None:
             _commit_pending()
