@@ -51,7 +51,7 @@ chaos-test: registry-smoke serve-smoke obs-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py \
-	    tests/test_flightrec.py \
+	    tests/test_flightrec.py tests/test_materialize_transport.py \
 	    -q -p no:cacheprovider
 
 # Observability smoke (docs/observability.md §Flight recorder): an
@@ -113,6 +113,17 @@ bench-smoke:
 	    python bench.py --phase pp_bubble | tail -1 \
 	    | python -c "import json,sys; r=json.load(sys.stdin); \
 	        assert 'schedule_analysis' in r, r; print('pp_bubble OK')"
+	JAX_PLATFORMS=cpu TDX_BENCH_PLATFORM=cpu TDX_BW_BENCH_MB=64 \
+	    TDX_BW_BENCH_SLABS=16 TDX_BW_BENCH_REPEATS=2 timeout -k 10 360 \
+	    python bench.py --phase materialize_bandwidth | tail -1 \
+	    | python -c "import json,math,sys; r=json.load(sys.stdin); \
+	        assert r.get('bitwise_equal') is True, r; \
+	        u = r.get('materialize_link_utilization'); \
+	        assert u is not None and math.isfinite(u) and u > 0, r; \
+	        print('materialize_bandwidth OK:', \
+	              'gbps', r.get('materialize_gbps'), \
+	              'link_util', u, \
+	              'overlap', r.get('transfer_overlap'))"
 
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,

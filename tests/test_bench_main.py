@@ -63,6 +63,17 @@ _HOST_PHASES = {
         "cold_auto_s": 26.0, "warm_auto_s": 4.0, "n_programs": 21,
         "workers": 4, "overlap": 3.8, "bitwise_equal": True,
         "pipeline_speedup": 1.408, "backend": "cpu", "_backend": "cpu"},
+    "materialize_bandwidth": {
+        "n_slabs": 32, "repeats": 3, "warm_default_s": 0.104,
+        "warm_bf16_s": 0.122, "warm_bf16_no_overlap_s": 0.139,
+        "warm_monolith_s": 0.104,
+        "bitwise_equal": True, "n_bytes_mb": 268.7,
+        "materialize_gbps": 2.584, "overlap_speedup": 0.933,
+        "link_bandwidth_gbps": 3.137, "link_probe_mb": 32,
+        "materialize_link_utilization": 0.82345, "n_programs": 8,
+        "transfer_overlap": 0.61, "bytes_donated": 8398848,
+        "device_put_batches": 0, "warm_execute_s": 0.077,
+        "backend": "cpu", "_backend": "cpu"},
     "pp_bubble": {"schedule_analysis": {"pp4_v2_m8": {"interleaved_ticks": 26}}},
     "serving": {
         "bring_up_cold_s": 4.1, "ttft_cold_s": 4.13,

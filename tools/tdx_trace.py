@@ -194,6 +194,40 @@ def summarize(events: List[dict], top: int = 15) -> str:
     else:
         lines.append("compile cache: no compile events recorded")
 
+    # Transport digest (docs/performance.md §transport), alongside the
+    # cache digest: the achieved materialize rate against the measured
+    # link, and how the bytes moved (donated fraction, batched puts,
+    # transfer time hidden behind execution).
+    gbps = counters.get("tdx.jax.materialize_gbps")
+    if gbps:
+        parts = [f"transport: {gbps:.3g} GB/s materialize"]
+        link = counters.get("tdx.jax.link_bandwidth_gbps")
+        if link:
+            probe = next(
+                (k.split("probe_mb=", 1)[1].rstrip("}")
+                 for k in counters
+                 if k.startswith("tdx.jax.link_bandwidth_gbps{probe_mb=")),
+                None,
+            )
+            util = counters.get("tdx.jax.link_utilization",
+                                gbps / link if link else 0.0)
+            parts.append(
+                f"{util:.1%} of {link:.2f} GB/s link"
+                + (f" (probe {probe} MB)" if probe else "")
+            )
+        moved = counters.get("tdx.jax.bytes_materialized", 0.0)
+        donated = counters.get("tdx.jax.bytes_donated", 0.0)
+        if donated:
+            frac = f" ({donated / moved:.0%} of materialized)" if moved else ""
+            parts.append(f"{donated / 1e6:.3g} MB donated{frac}")
+        batches = counters.get("tdx.jax.device_put_batches", 0.0)
+        if batches:
+            parts.append(f"{int(batches)} batched device_put(s)")
+        toverlap = counters.get("tdx.jax.transfer_overlap")
+        if toverlap is not None:
+            parts.append(f"transfer overlap {toverlap:.2f}")
+        lines.append(", ".join(parts))
+
     # Artifact-registry digest (docs/registry.md vocabulary), alongside
     # the compile-cache ratio it feeds: a healthy pod shows registry
     # fetch hits ≈ compile-cache hits on every host but the publishers.
@@ -406,7 +440,8 @@ def render_flight(path: str, doc: dict, top: int = 8) -> str:
 # fleet totals ARE the sum, like counters.
 _GAUGE_MAX_PREFIXES = (
     "tdx.serve.slo.", "tdx.jax.link_", "tdx.jax.hbm_high_water",
-    "tdx.jax.materialize_gbps", "tdx.train.mfu", "tdx.train.step_ms",
+    "tdx.jax.materialize_gbps", "tdx.jax.transfer_overlap",
+    "tdx.jax.pipeline_overlap", "tdx.train.mfu", "tdx.train.step_ms",
     "tdx.train.tflops",
 )
 

@@ -221,6 +221,7 @@ def materialize_parts(
     plan: Optional[ShardingPlan] = None,
     specs: Optional[Any] = None,
     param_dtype=None,
+    init_dtype=None,
 ):
     """The raw pieces of a :func:`materialize` program, un-jitted:
     ``(run_fn, out_shardings, treedef)`` where ``run_fn()`` computes the
@@ -228,7 +229,16 @@ def materialize_parts(
     runtime routes replica param-init through
     ``jax_bridge.materialize._compile_program`` so the artifact registry
     and the compile-cache telemetry cover it — build on this;
-    :func:`build_materialize_fn` is the plain-jit convenience on top."""
+    :func:`build_materialize_fn` is the plain-jit convenience on top.
+
+    ``init_dtype`` arms the low-precision transport fast path
+    (docs/performance.md §transport) for this program: leaves the
+    ``param_dtype`` cast mask permits whose contract dtype is WIDER than
+    ``init_dtype`` are computed/stored by the program in ``init_dtype``
+    (halving the bytes moved).  The returned ``run_fn`` then delivers
+    those leaves in ``init_dtype`` — the CALLER owns the on-device
+    upcast (``jax_bridge.transport.commit_outputs``; the serving
+    bring-up in ``serve.engine.spin_up_replica`` does exactly this)."""
     fakes, treedef = jax.tree.flatten(tree, is_leaf=is_fake)
     for f in fakes:
         if not is_fake(f):
@@ -237,8 +247,9 @@ def materialize_parts(
     wanted = [f._leaf_idx for f in fakes]
     run_all = thunk.leaves_fn()
 
+    elig = [_cast_eligible(f, thunk) for f in fakes]
     if param_dtype is not None:
-        cast = [_cast_eligible(f, thunk) for f in fakes]
+        cast = elig
     else:
         cast = [False] * len(fakes)
 
@@ -247,6 +258,18 @@ def materialize_parts(
         return tuple(
             leaves[i].astype(param_dtype) if c else leaves[i]
             for i, c in zip(wanted, cast)
+        )
+
+    if init_dtype is not None:
+        from .jax_bridge import transport
+
+        finals = [
+            jnp.dtype(param_dtype) if c else jnp.dtype(f.dtype)
+            for f, c in zip(fakes, cast)
+        ]
+        run_selected = transport.wrap_storage(
+            run_selected,
+            transport.plan_transport(finals, elig, init_dtype),
         )
 
     out_shardings = None

@@ -60,6 +60,36 @@ Environment variables (read at first import):
                         an interrupted materialization (fault,
                         ``MaterializationError``, SIGTERM) skips the
                         already-materialized groups ("" disables).
+``TDX_MATERIALIZE_OVERLAP_DEPTH``
+                        In-flight slot count of the pipelined engine's
+                        double-buffered dispatcher (default 2): up to this
+                        many executed-but-uncommitted groups stay in
+                        flight, so group *k+1*'s execution overlaps group
+                        *k*'s output commit/transfer.  1 serializes
+                        execute→commit per group (see
+                        docs/performance.md §transport).
+``TDX_MATERIALIZE_DONATE``
+                        "0" disables buffer donation in the materialize
+                        transport layer (the commit/upcast programs and
+                        device→device transfers consume their inputs by
+                        default — pass-through slots alias buffers, spent
+                        staging buffers free at consumption; see
+                        docs/performance.md §transport).
+``TDX_MATERIALIZE_INIT_DTYPE``
+                        Opt-in low-precision init fast path (e.g.
+                        ``bf16``): slots the parameter cast-mask permits
+                        are computed/stored by the init program in this
+                        dtype — halving the bytes the transport moves —
+                        and upcast to their contract dtype on device by a
+                        donated-buffer program.  Exact-bitwise when the
+                        contract dtype already is the init dtype;
+                        documented tolerance otherwise ("" disables; see
+                        docs/performance.md §transport).
+``TDX_MATERIALIZE_BATCH_PUT``
+                        "0" disables per-sharding batching of host→device
+                        transfers (resume loads fall back to one
+                        ``jax.device_put`` per array — the pre-transport
+                        behavior, kept as an escape hatch / A-B knob).
 ``TDX_LOG_LEVEL``       Logging level name for the framework logger.
 ``TDX_TRACE_DIR``       Directory for runtime telemetry traces: when set,
                         :mod:`torchdistx_tpu.observe` collects spans across
@@ -129,6 +159,10 @@ class Config:
     compile_deadline_s: float = 0.0
     materialize_retries: int = 2
     materialize_resume_dir: Optional[str] = None
+    materialize_overlap_depth: int = 2
+    materialize_donate: bool = True
+    materialize_init_dtype: Optional[str] = None
+    materialize_batch_put: bool = True
 
 
 def _from_env() -> Config:
@@ -150,6 +184,16 @@ def _from_env() -> Config:
         materialize_retries=int(os.environ.get("TDX_MATERIALIZE_RETRIES", "2")),
         materialize_resume_dir=(
             os.environ.get("TDX_MATERIALIZE_RESUME_DIR", "") or None
+        ),
+        materialize_overlap_depth=int(
+            os.environ.get("TDX_MATERIALIZE_OVERLAP_DEPTH", "2")
+        ),
+        materialize_donate=os.environ.get("TDX_MATERIALIZE_DONATE", "1") != "0",
+        materialize_init_dtype=(
+            os.environ.get("TDX_MATERIALIZE_INIT_DTYPE", "") or None
+        ),
+        materialize_batch_put=(
+            os.environ.get("TDX_MATERIALIZE_BATCH_PUT", "1") != "0"
         ),
     )
 

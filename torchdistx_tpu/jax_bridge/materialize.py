@@ -70,6 +70,7 @@ from .._graph import gc_paused
 from ..fake import is_fake
 from ..parallel.sharding import ShardingPlan
 from ..utils.logging import get_logger
+from . import transport
 from .compile import build_init_fn, group_fingerprint, split_init_groups
 
 __all__ = [
@@ -632,14 +633,21 @@ _registry_nocache_warned = False
 
 
 def _registry_program_fp(fake_list, idxs, out_shardings, param_dtype,
-                         cast_mask) -> Optional[str]:
+                         cast_mask, transport_fp=None) -> Optional[str]:
     """Registry key material for one init program: the cross-process
     content fingerprint of the group's recorded computation
     (:func:`..compile.group_fingerprint`) composed with the output
     contract (cast policy, planned shardings) — everything the compiled
     executable depends on EXCEPT the runtime PRNG key, so one artifact
     serves every seed.  None when no stable fingerprint exists (the
-    program is then simply not registry-eligible)."""
+    program is then simply not registry-eligible).
+
+    ``transport_fp`` is the low-precision transport's per-slot storage
+    record (:meth:`..transport.TransportPlan.fp_material`): the init
+    dtype changes the compiled program, so its artifacts must never
+    collide with default-path ones.  None (the default config) leaves
+    the digest byte-identical to the pre-transport scheme — warmed
+    registries stay valid."""
     import hashlib
 
     try:
@@ -652,6 +660,8 @@ def _registry_program_fp(fake_list, idxs, out_shardings, param_dtype,
         osh = out_shardings[i] if out_shardings is not None else None
         h.update(repr((pos, str(param_dtype), bool(cast_mask[i]),
                        str(osh))).encode())
+    if transport_fp is not None:
+        h.update(repr(("transport", transport_fp)).encode())
     return h.hexdigest()
 
 
@@ -687,20 +697,29 @@ def _cast_outputs(init_fn, param_dtype, mask=None):
     ``mask`` selects which outputs are eligible (module entry points pass
     the is-an-``nn.Parameter`` mask: float BUFFERS like RoPE ``inv_freq``
     or batchnorm running stats must keep full precision under a bf16
-    param policy).  Integer/bool outputs are never cast."""
+    param policy).  Integer/bool outputs are never cast.
+
+    Delegates to :func:`..compile.cast_program_outputs` — the ONE cast
+    primitive the transport storage cast also builds on, so the cast
+    point (and what XLA fuses it into) can never drift between the
+    ``param_dtype`` policy and the low-precision transport."""
     if param_dtype is None:
         return init_fn
-    import jax.numpy as jnp
+    from .compile import cast_program_outputs
+
+    if mask is not None:
+        return cast_program_outputs(
+            init_fn, [param_dtype if m else None for m in mask]
+        )
 
     def fn(key):
+        # Mask-less caller (slot count unknown until trace): every
+        # floating output is eligible — same trace-time guard the
+        # primitive applies.
         outs = init_fn(key)
-        sel = mask if mask is not None else [True] * len(outs)
-        return tuple(
-            o.astype(param_dtype)
-            if m and jnp.issubdtype(o.dtype, jnp.floating)
-            else o
-            for o, m in zip(outs, sel)
-        )
+        return cast_program_outputs(
+            lambda: outs, [param_dtype] * len(outs)
+        )()
 
     return fn
 
@@ -718,10 +737,15 @@ def last_run_stats() -> Dict:
     programs), ``execute_s`` (monolithic: device execution; pipelined:
     dispatch plus the residual device wait not hidden behind compiles),
     ``wall_s``, ``overlap`` (busy/wall; >1 means phases genuinely
-    overlapped), ``cache`` (outcome → count), and — when the compiler
-    probes are available — ``xla_flops`` / ``xla_bytes_accessed``
-    (summed over programs) and ``xla_peak_bytes`` (largest
-    single-program device footprint), from
+    overlapped), ``cache`` (outcome → count), the transport-layer
+    accounting (``bytes_donated`` — input bytes the commit programs
+    consumed via donation; ``transfer_overlap`` — commit/transfer time
+    hidden behind other groups' execution ÷ wall, the
+    ``tdx.jax.transfer_overlap`` gauge; ``device_put_batches`` —
+    per-sharding batched host→device dispatches the resume path
+    issued), and — when the compiler probes are available —
+    ``xla_flops`` / ``xla_bytes_accessed`` (summed over programs) and
+    ``xla_peak_bytes`` (largest single-program device footprint), from
     :func:`..observe.costmodel.program_costs`."""
     with _stats_lock:
         return dict(_last_run_stats)
@@ -943,12 +967,19 @@ def _execute_compiled(compiled, key, gno, *, deadline, fault_plan,
 
 
 def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
-              program_fp=None):
+              program_fp=None, tplan=None):
     """Monolithic engine: one program, lower → compile → execute, each
     stage under the self-healing ladder (bounded retries with backoff;
     the final retry bypasses the persistent cache; a deadline-armed
     watchdog abandons a wedged stage).  Exhaustion raises
     :class:`MaterializationError`.
+
+    ``tplan`` is the low-precision transport plan
+    (docs/performance.md §transport): when set, ``init_fn`` already
+    stores its eligible outputs in the init dtype and the commit/upcast
+    program runs after execute (donated per ``TDX_MATERIALIZE_DONATE``;
+    a retry whose donated inputs were consumed re-executes the init
+    program to regenerate them).
 
     Returns with the values RESIDENT (block_until_ready) — both engines
     share that contract so "materialized" means landed, the execute span
@@ -961,6 +992,7 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
     cfg = config.get()
     retries = max(0, cfg.materialize_retries)
     deadline = cfg.compile_deadline_s or None
+    donate = cfg.materialize_donate
     retryable = _retryable_errors()
     t_wall = time.perf_counter()
 
@@ -978,12 +1010,21 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
             # TERMINAL (wrapped non-retryable below) — re-entering the
             # outer compile ladder would recompile an executable that
             # was never the problem and square the documented budget.
-            try:
-                out = _execute_compiled(
+            def _produce():
+                return _execute_compiled(
                     compiled, key, 1, deadline=deadline,
                     fault_plan=fault_plan, retries=retries,
                     retryable=retryable,
                 )
+
+            try:
+                out = _produce()
+                donated = 0
+                if tplan is not None:
+                    out, donated = transport.commit_outputs(
+                        out, tplan, donate=donate, producer=_produce,
+                        retries=retries, retryable=retryable,
+                    )
             except Exception as e:  # noqa: BLE001 — classified below
                 if isinstance(e, retryable):
                     raise MaterializationError(
@@ -993,13 +1034,15 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
                     ) from e
                 raise
             esp.block_on(out)
+            if donated:
+                esp.set(donated_bytes=donated)
         jax.block_until_ready(out)
         return (out, t_lower, t_compile, time.perf_counter() - t0, outcome,
-                a, costs)
+                a, costs, donated)
 
     try:
         (out, t_lower, t_compile, t_exec, outcome, attempts,
-         costs) = _run_ladder(
+         costs, donated) = _run_ladder(
             _attempt, retries=retries, retryable=retryable,
             describe="monolithic program", bypass_note=True,
         )
@@ -1016,6 +1059,8 @@ def _run_init(init_fn, key, out_shardings=None, *, fault_plan=None,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
         wall_s=time.perf_counter() - t_wall,
         overlap=1.0, cache={outcome: 1}, retries=attempts,
+        bytes_donated=int(donated), transfer_overlap=0.0,
+        device_put_batches=0,
         **(_cost_stats(costs) if costs else {}),
     )
     return out
@@ -1086,30 +1131,49 @@ def _commit_resume_group(rdir: str, groups: Dict[str, dict], fp: str,
 
 
 def _try_resume_group(rdir: str, fp: str, rec: dict, idxs: List[int],
-                      out_shardings) -> Optional[List]:
+                      out_shardings, *,
+                      batch_put: bool = True) -> Optional[Tuple[List, int]]:
     """Load one committed group's outputs back onto the devices with
     their planned shardings; None (recompute) on ANY mismatch — wrong
-    indices, missing file, CRC failure, bad shape."""
+    indices, missing file, CRC failure, bad shape.  Returns
+    ``(values, n_device_put_batches)``.
+
+    Transfers go through :func:`..transport.batched_device_put` — ONE
+    dispatch per distinct ``NamedSharding`` in the group instead of one
+    per array, so resuming a many-leaf group no longer pays per-leaf
+    dispatch overhead (``batch_put=False`` keeps the legacy per-leaf
+    path as an A/B escape hatch, ``TDX_MATERIALIZE_BATCH_PUT=0``)."""
     if rec.get("indices") != list(idxs):
         return None
     if len(rec.get("outputs") or ()) != len(idxs):
         return None  # truncated manifest entry: a hole, not a resume
-    vals: List = []
+    arrs: List[np.ndarray] = []
     try:
-        for i, o in zip(idxs, rec["outputs"]):
+        for o in rec["outputs"]:
             with open(os.path.join(rdir, fp, o["file"]), "rb") as f:
                 data = f.read()
             if zlib.crc32(data) != o["crc32"]:
                 return None
             arr = np.frombuffer(data, dtype=_np_dtype(o["dtype"]))
-            arr = arr.reshape(o["shape"])
+            arrs.append(arr.reshape(o["shape"]))
+    except Exception:  # noqa: BLE001 — any load failure: recompute
+        return None
+    try:
+        if batch_put:
+            shardings = (
+                [out_shardings[i] for i in idxs]
+                if out_shardings is not None else None
+            )
+            return transport.batched_device_put(arrs, shardings)
+        vals: List = []
+        for i, arr in zip(idxs, arrs):
             if out_shardings is not None:
                 vals.append(jax.device_put(arr, out_shardings[i]))
             else:
                 vals.append(jax.numpy.asarray(arr))
-    except Exception:  # noqa: BLE001 — any load/reshard failure: recompute
+        return vals, 0
+    except Exception:  # noqa: BLE001 — any reshard failure: recompute
         return None
-    return vals
 
 
 def _clear_resume_state(rdir: str) -> None:
@@ -1184,11 +1248,13 @@ def _plan_pipeline(fake_list) -> Optional[List[List[int]]]:
 
 
 def _group_fp(fake_list, idxs, out_shardings, param_dtype, cast_mask,
-              seed) -> Optional[str]:
+              seed, transport_fp=None) -> Optional[str]:
     """Resume-manifest key for one group: the content fingerprint of its
     recorded computation composed with everything else the output values
-    depend on (seed, cast policy, planned shardings).  None when a
-    stable fingerprint cannot be built (the group is then simply never
+    depend on (seed, cast policy, planned shardings, and — when the
+    low-precision transport is active — the per-slot storage dtypes,
+    whose rounding changes the committed values).  None when a stable
+    fingerprint cannot be built (the group is then simply never
     resumed)."""
     import hashlib
 
@@ -1201,13 +1267,51 @@ def _group_fp(fake_list, idxs, out_shardings, param_dtype, cast_mask,
         osh = out_shardings[i] if out_shardings is not None else None
         h.update(repr((i, seed, str(param_dtype), bool(cast_mask[i]),
                        str(osh))).encode())
+    if transport_fp is not None:
+        h.update(repr(("transport", transport_fp)).encode())
     return h.hexdigest()
 
 
+def _transport_plan(fake_list, idxs, out_shardings, param_dtype, cast_mask,
+                    init_dtype) -> Optional["transport.TransportPlan"]:
+    """The :class:`..transport.TransportPlan` for one program's slots
+    (None in default config — the engines then run their bitwise-pinned
+    path with zero transport work).  The contract dtype per slot is what
+    the DEFAULT path would deliver: ``param_dtype`` where the cast mask
+    permits, the recorded dtype otherwise — the fast path changes how
+    bytes move, never which dtype lands."""
+    if init_dtype is None:
+        return None
+    import jax.numpy as jnp
+
+    from ._dtypes import jax_dtype
+
+    finals = []
+    mask = []
+    for i in idxs:
+        try:
+            d = jnp.dtype(jax_dtype(fake_list[i].dtype))
+        except NotImplementedError:
+            return None  # exotic dtype in the group: default path
+        m = bool(cast_mask[i])
+        if (param_dtype is not None and m
+                and jnp.issubdtype(d, jnp.floating)):
+            d = jnp.dtype(param_dtype)
+        finals.append(d)
+        mask.append(m)
+    osh = (
+        [out_shardings[i] for i in idxs]
+        if out_shardings is not None else None
+    )
+    return transport.plan_transport(finals, mask, init_dtype, osh)
+
+
 def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
-                        cast_mask, *, seed=0, fault_plan=None):
+                        cast_mask, *, seed=0, fault_plan=None,
+                        init_dtype=None):
     """Pipelined engine: concurrent per-group build/lower/compile on a
-    worker pool, execution dispatched as each executable lands.
+    worker pool, execution dispatched as each executable lands through a
+    DOUBLE-BUFFERED commit queue (docs/performance.md §transport).
 
     Workers overlap three ways: Python tracing of group B proceeds while
     group A sits in GIL-free XLA compilation; compiles of several groups
@@ -1215,6 +1319,18 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
     execute of finished groups (async device work) overlaps the remaining
     compiles.  Outputs stream straight into their planned NamedShardings
     — there is no gather or reorder step, each slot is written once.
+
+    Groups with real commit WORK (a low-precision upcast or a resume
+    write) enter a bounded in-flight queue of
+    ``TDX_MATERIALIZE_OVERLAP_DEPTH`` (default 2) slots: group *k+1*'s
+    execution overlaps group *k*'s output commit/transfer, bounding
+    transient memory while hiding transfer time — the hidden fraction
+    is exported as ``tdx.jax.transfer_overlap`` and each metered
+    group's ``jax.commit`` span carries its ``exec_gbps``.  Groups with
+    no commit work stay fully asynchronous (default config pays zero
+    per-group residency waits).  ``init_dtype`` arms the low-precision
+    transport for eligible slots (storage cast inside each group
+    program, donated upcast at commit).
 
     Fault tolerance (docs/robustness.md): each group runs the bounded
     retry ladder (backoff; final retry bypasses the persistent cache)
@@ -1242,8 +1358,17 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
     eff_cfg = config.get()
     retries = max(0, eff_cfg.materialize_retries)
     deadline = eff_cfg.compile_deadline_s or None
+    depth = max(1, eff_cfg.materialize_overlap_depth)
+    donate = eff_cfg.materialize_donate
+    batch_put = eff_cfg.materialize_batch_put
     retryable = _retryable_errors()
     rdir = eff_cfg.materialize_resume_dir
+    tplans = [
+        _transport_plan(fake_list, idxs, out_shardings, param_dtype,
+                        cast_mask, init_dtype)
+        for idxs in bins
+    ]
+    n_put_batches = 0
 
     manifest: Dict[str, dict] = {}
     fps: List[Optional[str]] = [None] * len(bins)
@@ -1252,15 +1377,21 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
         os.makedirs(rdir, exist_ok=True)
         manifest = _load_resume_manifest(rdir)
         for gi, idxs in enumerate(bins):
-            fps[gi] = _group_fp(fake_list, idxs, out_shardings, param_dtype,
-                                cast_mask, seed)
+            fps[gi] = _group_fp(
+                fake_list, idxs, out_shardings, param_dtype, cast_mask,
+                seed,
+                tplans[gi].fp_material() if tplans[gi] else None,
+            )
             rec = manifest.get(fps[gi]) if fps[gi] else None
             if rec is None:
                 continue
-            vals = _try_resume_group(rdir, fps[gi], rec, idxs, out_shardings)
-            if vals is None:
+            loaded = _try_resume_group(rdir, fps[gi], rec, idxs,
+                                       out_shardings, batch_put=batch_put)
+            if loaded is None:
                 manifest.pop(fps[gi], None)  # stale/corrupt: recompute
                 continue
+            vals, nput = loaded
+            n_put_batches += nput
             for i, v in zip(idxs, vals):
                 results[i] = v
             resumed.add(gi)
@@ -1294,8 +1425,10 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
             n_outputs=len(sub),
         ):
             program_fp = (
-                _registry_program_fp(fake_list, idxs, out_shardings,
-                                     param_dtype, cast_mask)
+                _registry_program_fp(
+                    fake_list, idxs, out_shardings, param_dtype, cast_mask,
+                    tplans[gi].fp_material() if tplans[gi] else None,
+                )
                 if eff_cfg.registry_dir else None
             )
 
@@ -1305,6 +1438,7 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                     fn = _cast_outputs(
                         fn, param_dtype, [cast_mask[i] for i in idxs]
                     )
+                fn = transport.wrap_storage(fn, tplans[gi])
                 osh = (
                     tuple(out_shardings[i] for i in idxs)
                     if out_shardings is not None else None
@@ -1326,10 +1460,85 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
     agg_costs: Dict[str, float] = {}
     failed: Dict[int, BaseException] = {}
     completed: set = set(resumed)
+    inflight: List[Dict] = []
+    tracker = transport.OverlapTracker()
+    bytes_donated = 0
+
+    def _commit_entry(ent) -> None:
+        """Commit one in-flight executed group: run the low-precision
+        upcast (donated per config), wait for residency, account the
+        dispatch→resident rate, then write the resume entry.  Only
+        groups with real commit WORK (a transport plan, or a resume
+        entry to write) enter this path — a default-config group stays
+        fully async and lands at the end barrier, exactly the
+        pre-transport behavior.  An async execution failure surfaces at
+        the residency wait — classified like any execute failure
+        (→ ladder → monolithic fallback), not a crash."""
+        nonlocal t_exec, bytes_donated
+        gi, idxs = ent["gi"], ent["idxs"]
+        outs = ent["outs"]
+        t0 = time.perf_counter()
+        try:
+            with observe.span(
+                "jax.commit", category="jax", group=gi
+            ) as csp:
+                if tplans[gi] is not None:
+                    outs, dn = transport.commit_outputs(
+                        outs, tplans[gi], donate=donate,
+                        producer=ent["producer"], retries=retries,
+                        retryable=retryable,
+                    )
+                    bytes_donated += dn
+                    if dn:
+                        csp.set(donated_bytes=dn)
+                jax.block_until_ready(outs)
+                # Dispatch→resident duration vs how long the dispatcher
+                # actually WAITED here: the difference is transfer time
+                # hidden behind other groups' execution/compiles.
+                wait = time.perf_counter() - t0
+                dur = time.perf_counter() - ent["t0"]
+                hidden = tracker.note(dur, wait)
+                nbytes = sum(int(v.size) * v.dtype.itemsize for v in outs)
+                csp.set(
+                    bytes=nbytes,
+                    exec_gbps=nbytes / dur / 1e9 if dur > 0 else 0.0,
+                    hidden_s=round(hidden, 4),
+                )
+        except Exception as e:  # noqa: BLE001 — classified just below
+            t_exec += time.perf_counter() - t0
+            if not isinstance(e, retryable):
+                raise
+            failed[gi] = e
+            log.error(
+                "materialize: group %d failed at commit (%s: %s)",
+                gi, type(e).__name__, str(e)[:160],
+            )
+            return
+        t_exec += time.perf_counter() - t0
+        for i, v in zip(idxs, outs):
+            results[i] = v
+        completed.add(gi)
+        if rdir and fps[gi]:
+            # Residency was forced above; the progress write itself is
+            # an OPTIONAL amenity: a full disk, or np.asarray refusing
+            # a non-fully-addressable sharded output (multi-host), must
+            # cost the resume entry, never the materialization.
+            try:
+                _commit_resume_group(
+                    rdir, manifest, fps[gi], idxs,
+                    [results[i] for i in idxs],
+                )
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "materialize: progress commit of group %d failed "
+                    "(%s: %s); resume will recompute it",
+                    gi, type(e).__name__, e,
+                )
+
     try:
         with observe.span(
             "jax.pipeline", category="jax", n_programs=len(bins),
-            workers=workers,
+            workers=workers, depth=depth,
         ) as psp:
             pool = ThreadPoolExecutor(
                 max_workers=workers, thread_name_prefix="tdx-compile"
@@ -1405,50 +1614,45 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                             )
                             continue
                         t_exec += time.perf_counter() - t0
-                        for i, v in zip(idxs, outs):
-                            results[i] = v
-                        completed.add(gi)
-                        if rdir and fps[gi]:
-                            # Progress commit forces residency (the bytes
-                            # are read back); documented cost of arming
-                            # resume — off by default.  An ASYNC execution
-                            # error surfaces at this block: classify it
-                            # like any execute failure, not a crash.
-                            try:
-                                jax.block_until_ready(
-                                    [results[i] for i in idxs]
+                        if tplans[gi] is None and not (rdir and fps[gi]):
+                            # No commit work: stay fully async (results
+                            # land at the end barrier) — forcing a
+                            # per-group residency wait here would only
+                            # serialize dispatch against device work.
+                            for i, v in zip(idxs, outs):
+                                results[i] = v
+                            completed.add(gi)
+                            continue
+                        inflight.append({
+                            "gi": gi, "idxs": idxs, "outs": outs, "t0": t0,
+                            # Idempotent regeneration for the donation
+                            # retry ladder: the PRNG key is never donated,
+                            # so re-executing the group program is safe.
+                            "producer": (
+                                lambda c=compiled, g=gi: _execute_compiled(
+                                    c, key, g + 1, deadline=deadline,
+                                    fault_plan=fault_plan, retries=retries,
+                                    retryable=retryable,
                                 )
-                            except Exception as e:  # noqa: BLE001
-                                if not isinstance(e, retryable):
-                                    raise
-                                completed.discard(gi)
-                                failed[gi] = e
-                                log.error(
-                                    "materialize: group %d failed "
-                                    "asynchronously (%s: %s)", gi,
-                                    type(e).__name__, str(e)[:160],
-                                )
-                                continue
-                            try:
-                                _commit_resume_group(
-                                    rdir, manifest, fps[gi], idxs,
-                                    [results[i] for i in idxs],
-                                )
-                            except Exception as e:  # noqa: BLE001
-                                # The commit is an OPTIONAL amenity: a
-                                # full disk, or np.asarray refusing a
-                                # non-fully-addressable sharded output
-                                # (multi-host), must cost the resume
-                                # entry, never the materialization.
-                                log.warning(
-                                    "materialize: progress commit of group "
-                                    "%d failed (%s: %s); resume will "
-                                    "recompute it", gi, type(e).__name__, e,
-                                )
+                            ),
+                        })
+                        # Double-buffered commit: keep up to `depth`
+                        # executed groups in flight, so the NEXT group's
+                        # execution overlaps this one's commit/transfer
+                        # while transient memory (low-precision staging
+                        # plus final buffers) stays bounded.
+                        while len(inflight) >= depth:
+                            _commit_entry(inflight.pop(0))
             except BaseException:
                 pool.shutdown(wait=True, cancel_futures=True)
                 raise
             pool.shutdown(wait=True, cancel_futures=drain["requested"])
+
+            # Whatever is still in flight is EXECUTED work — commit it
+            # even on a drain: committed progress is what the drain is
+            # for, and the devices already paid for these groups.
+            while inflight:
+                _commit_entry(inflight.pop(0))
 
             if drain["requested"]:
                 drain_handled = True
@@ -1475,15 +1679,15 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
                     resumable=bool(rdir),
                 )
 
-            # The dispatch loop above never blocked: execute_s is dispatch
-            # plus this residual device wait — the execution time NOT
-            # hidden behind compilation (per-program device busy time is
-            # not observable without serializing on per-group blocks).
-            # A device-side failure of any async dispatch also surfaces
-            # HERE; it must enter the ladder (→ monolithic fallback) as a
-            # typed error, not escape raw — which group failed is not
-            # attributable at the barrier, so no committed value is
-            # trusted.
+            # Groups WITH commit work were forced resident above by the
+            # double-buffered drain; async default-config groups and
+            # resumed device_puts land at this barrier — execute_s is
+            # dispatch plus the per-group commit waits plus this
+            # residual.  A device-side failure of an async dispatch
+            # surfaces HERE; it must enter the ladder (→ monolithic
+            # fallback) as a typed error, not escape raw — which group
+            # failed is not attributable at the barrier, so no committed
+            # value is trusted.
             t0 = time.perf_counter()
             try:
                 jax.block_until_ready(results)
@@ -1500,10 +1704,15 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
             wall = time.perf_counter() - t_wall
             busy = t_lower + t_compile + t_exec
             overlap = busy / wall if wall > 0 else 1.0
-            psp.set(overlap=round(overlap, 3), cache=dict(outcomes))
+            transfer_overlap = tracker.overlap(wall)
+            psp.set(overlap=round(overlap, 3), cache=dict(outcomes),
+                    transfer_overlap=transfer_overlap)
             if observe.enabled():
                 observe.gauge("tdx.jax.pipeline_overlap").set(
                     round(overlap, 3)
+                )
+                observe.gauge("tdx.jax.transfer_overlap").set(
+                    transfer_overlap
                 )
     finally:
         if handler_installed:
@@ -1522,6 +1731,9 @@ def _run_init_pipelined(fake_list, bins, key, out_shardings, param_dtype,
         mode="pipelined", n_programs=len(bins), workers=workers,
         lower_s=t_lower, compile_s=t_compile, execute_s=t_exec,
         wall_s=wall, overlap=round(overlap, 3), cache=outcomes,
+        bytes_donated=int(bytes_donated),
+        transfer_overlap=transfer_overlap,
+        device_put_batches=n_put_batches,
         **(_cost_stats(agg_costs) if agg_costs else {}),
     )
     return tuple(results)
@@ -1554,8 +1766,11 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
         fault_plan = chaos.active_plan()
         bins = _plan_pipeline(fake_list) if mode == "auto" else None
         key = jax.random.PRNGKey(seed)
+        init_dtype = transport.resolve_init_dtype(
+            config.get().materialize_init_dtype
+        )
 
-        def _whole_fp():
+        def _whole_fp(tplan=None):
             # The whole-model program's registry fingerprint — computed
             # only when a registry is configured (a full graph walk).
             if not config.get().registry_dir:
@@ -1563,12 +1778,13 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
             return _registry_program_fp(
                 fake_list, list(range(len(fake_list))), out_shardings,
                 param_dtype, cast_mask,
+                tplan.fp_material() if tplan is not None else None,
             )
 
         try:
             values = _run_engines(
                 fake_list, bins, key, out_shardings, seed, param_dtype,
-                cast_mask, fault_plan, _whole_fp,
+                cast_mask, fault_plan, _whole_fp, init_dtype,
             )
         except MaterializationError as e:
             # The whole ladder is spent and the error is about to escape
@@ -1609,23 +1825,33 @@ def _materialize_values(fake_list, out_shardings, seed, param_dtype,
 
 
 def _run_engines(fake_list, bins, key, out_shardings, seed, param_dtype,
-                 cast_mask, fault_plan, _whole_fp):
+                 cast_mask, fault_plan, _whole_fp, init_dtype=None):
     """Engine selection + the monolithic-fallback rung, extracted from
     :func:`_materialize_values` so the failure-dump wrapper there reads
     straight-line."""
     from .. import config
 
-    if bins is None:
-        init_fn = _cast_outputs(
-            build_init_fn(fake_list), param_dtype, cast_mask
+    def _monolith_fn_and_plan():
+        tplan = _transport_plan(
+            fake_list, range(len(fake_list)), out_shardings, param_dtype,
+            cast_mask, init_dtype,
         )
+        fn = transport.wrap_storage(
+            _cast_outputs(build_init_fn(fake_list), param_dtype, cast_mask),
+            tplan,
+        )
+        return fn, tplan
+
+    if bins is None:
+        init_fn, tplan = _monolith_fn_and_plan()
         return _run_init(init_fn, key, out_shardings,
                          fault_plan=fault_plan,
-                         program_fp=_whole_fp())
+                         program_fp=_whole_fp(tplan), tplan=tplan)
     try:
         return _run_init_pipelined(
             fake_list, bins, key, out_shardings, param_dtype,
             cast_mask, seed=seed, fault_plan=fault_plan,
+            init_dtype=init_dtype,
         )
     except MaterializationError as e:
         if e.drained:
@@ -1639,13 +1865,11 @@ def _run_engines(fake_list, bins, key, out_shardings, seed, param_dtype,
             "materialize: pipelined engine failed (%s); falling "
             "back to the monolithic program", e,
         )
-        init_fn = _cast_outputs(
-            build_init_fn(fake_list), param_dtype, cast_mask
-        )
+        init_fn, tplan = _monolith_fn_and_plan()
         try:
             values = _run_init(init_fn, key, out_shardings,
                                fault_plan=fault_plan,
-                               program_fp=_whole_fp())
+                               program_fp=_whole_fp(tplan), tplan=tplan)
         except MaterializationError as e2:
             # The whole ladder is spent; surface the pipelined
             # run's partial progress so a rerun can resume it.
@@ -1802,11 +2026,27 @@ def lower_init_module(
     programs execute once, and ``xla_allow_excess_precision=False``,
     without which bf16 chains lose bitwise parity with torch replay).
     """
+    from .. import config
+
     fakes = named_fake_tensors(module)
     names, init_fn, out_shardings = _init_and_shardings(fakes, mesh, plan)
+    mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
     if param_dtype is not None:
-        mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
         init_fn = _cast_outputs(init_fn, param_dtype, mask)
+    # The exported program must be the one a live materialize under the
+    # same config would compile — including the low-precision transport
+    # storage cast, so warmed caches and export artifacts stay valid
+    # when TDX_MATERIALIZE_INIT_DTYPE is armed.
+    init_dtype = transport.resolve_init_dtype(
+        config.get().materialize_init_dtype
+    )
+    if init_dtype is not None:
+        fake_list = [fakes[n] for n in names]
+        init_fn = transport.wrap_storage(
+            init_fn,
+            _transport_plan(fake_list, range(len(fake_list)), out_shardings,
+                            param_dtype, mask, init_dtype),
+        )
     jitted = jax.jit(init_fn, out_shardings=out_shardings)
     with observe.span("jax.lower", category="jax", n_outputs=len(names)):
         lowered = jitted.lower(jax.random.PRNGKey(0))
@@ -1831,9 +2071,14 @@ def lower_init_groups(
     returns an empty list when the model is below the pipeline threshold
     (the engine would run monolithic — warm that via
     :func:`lower_init_module`)."""
+    from .. import config
+
     fakes = named_fake_tensors(module)
     names, fake_list, out_shardings = _names_and_shardings(fakes, mesh, plan)
     mask = [isinstance(fakes[n], torch.nn.Parameter) for n in names]
+    init_dtype = transport.resolve_init_dtype(
+        config.get().materialize_init_dtype
+    )
     if max_programs is None:
         bins = _plan_pipeline(fake_list)
     else:
@@ -1846,6 +2091,13 @@ def lower_init_groups(
         fn = build_init_fn([fake_list[i] for i in idxs])
         if param_dtype is not None:
             fn = _cast_outputs(fn, param_dtype, [mask[i] for i in idxs])
+        # Same storage-cast decision the pipelined engine makes for this
+        # group under the current config (warm_cache parity).
+        fn = transport.wrap_storage(
+            fn,
+            _transport_plan(fake_list, idxs, out_shardings, param_dtype,
+                            mask, init_dtype),
+        )
         osh = (
             tuple(out_shardings[i] for i in idxs)
             if out_shardings is not None else None

@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 
 __all__ = [
     "link_bandwidth_gbps",
+    "link_probe_size_mb",
     "note_program_memory",
     "program_costs",
     "reset_link_probe",
@@ -123,58 +124,86 @@ def note_program_memory(costs: Optional[Dict[str, float]]) -> None:
 #
 # The ROADMAP's bandwidth gap headline needs a denominator: 0.19 GB/s is
 # meaningless until it is divided by what THIS host→device link can
-# actually move.  The probe device_puts a buffer a few times and takes
-# the best rate (max, not min: we want attainable bandwidth, and any
-# interference only lowers a sample).  Measured once per process and
-# cached — the link does not change under us, and the probe costs a few
-# tens of milliseconds.
+# actually move.  The probe device_puts buffers of a few SIZES a few
+# times each and takes the best rate (max, not min: we want attainable
+# bandwidth, and any interference only lowers a sample; the size sweep
+# keeps a single too-small buffer from under-measuring a fast link
+# whose fixed dispatch cost dominates small transfers — exactly the
+# skew that would inflate the utilization headline's denominator...
+# or deflate its numerator).  Measured once per process and cached —
+# the link does not change under us, and the sweep costs well under a
+# second.
 
 _link_lock = threading.Lock()
 _link_gbps: Optional[float] = None
-_LINK_PROBE_MB_DEFAULT = 32
+_link_probe_mb: Optional[int] = None
+_LINK_PROBE_SWEEP_MB = (8, 32, 128)
 _LINK_PROBE_REPEATS = 3
+
+
+def _probe_sizes_mb(probe_mb: Optional[int]) -> tuple:
+    """The probe sizes to sweep: an explicit argument pins one size;
+    ``TDX_LINK_PROBE_MB`` accepts one size or a comma list; default is
+    the built-in 8/32/128 MB sweep."""
+    import os
+
+    if probe_mb:
+        return (int(probe_mb),)
+    env = os.environ.get("TDX_LINK_PROBE_MB", "")
+    if env:
+        return tuple(int(p) for p in env.split(",") if p.strip())
+    return _LINK_PROBE_SWEEP_MB
 
 
 def link_bandwidth_gbps(probe_mb: Optional[int] = None, *,
                         cached_only: bool = False) -> Optional[float]:
     """Measured host→device transfer bandwidth (GB/s), cached per
-    process; None when the probe failed (no usable device).  Probe size
-    via ``TDX_LINK_PROBE_MB`` (default 32 MB — large enough to amortize
-    dispatch, small enough to never matter for memory).
+    process; None when the probe failed (no usable device).  Sweeps the
+    ``TDX_LINK_PROBE_MB`` sizes (default 8,32,128 MB) and keeps the best
+    size's best rate; the chosen size is exported as a ``probe_mb``
+    label on the gauge and via :func:`link_probe_size_mb`.
 
     ``cached_only`` returns the cached value or None WITHOUT probing —
     for callers inside a timed region or an open span, where the
     first-call probe (tens of ms of device_puts) would skew the very
     numbers it contextualizes."""
-    global _link_gbps
+    global _link_gbps, _link_probe_mb
     with _link_lock:
         if _link_gbps is not None:
             return _link_gbps if _link_gbps > 0 else None
         if cached_only:
             return None
-        import os
-
         import numpy as np
 
         try:
             import jax
 
-            mb = probe_mb or int(
-                os.environ.get("TDX_LINK_PROBE_MB", str(_LINK_PROBE_MB_DEFAULT))
-            )
-            n_bytes = mb * (1 << 20)
-            host = np.empty(n_bytes, dtype=np.uint8)
             dev = jax.devices()[0]
             best = 0.0
-            for _ in range(_LINK_PROBE_REPEATS):
-                t0 = time.perf_counter()
-                arr = jax.device_put(host, dev)
-                arr.block_until_ready()
-                dt = time.perf_counter() - t0
-                if dt > 0:
-                    best = max(best, n_bytes / dt / 1e9)
-                del arr
+            best_mb = None
+            for mb in _probe_sizes_mb(probe_mb):
+                n_bytes = mb * (1 << 20)
+                # Deliberately UNALIGNED view: an aligned host buffer
+                # can take a zero-copy/alias fast path on the CPU
+                # backend (observed: 8 MB "transferring" at 159 GB/s),
+                # which would put a fantasy denominator under the
+                # utilization headline.  Real accelerator links always
+                # copy; forcing the copy here keeps the CPU harness's
+                # number meaning the same thing.
+                buf = np.empty(n_bytes + 64, dtype=np.uint8)
+                host = buf[1:n_bytes + 1]
+                for _ in range(_LINK_PROBE_REPEATS):
+                    t0 = time.perf_counter()
+                    arr = jax.device_put(host, dev)
+                    arr.block_until_ready()
+                    dt = time.perf_counter() - t0
+                    if dt > 0 and n_bytes / dt / 1e9 > best:
+                        best = n_bytes / dt / 1e9
+                        best_mb = mb
+                    del arr
+                del host, buf
             _link_gbps = best if best > 0 else -1.0
+            _link_probe_mb = best_mb
         except Exception:  # noqa: BLE001 — no device, wedged tunnel, ...
             _link_gbps = -1.0
         if _link_gbps > 0:
@@ -182,15 +211,31 @@ def link_bandwidth_gbps(probe_mb: Optional[int] = None, *,
 
             if enabled():
                 gauge("tdx.jax.link_bandwidth_gbps").set(round(_link_gbps, 3))
+                # The labeled twin records WHICH buffer size won the
+                # sweep — the provenance a reader needs to trust the
+                # utilization denominator (a 8 MB winner on a fast link
+                # hints the sweep should be extended).
+                gauge(
+                    "tdx.jax.link_bandwidth_gbps",
+                    probe_mb=_link_probe_mb,
+                ).set(round(_link_gbps, 3))
             return _link_gbps
         return None
 
 
+def link_probe_size_mb() -> Optional[int]:
+    """The buffer size (MB) that won the link-probe sweep, or None when
+    the probe has not run (or failed)."""
+    with _link_lock:
+        return _link_probe_mb
+
+
 def reset_link_probe() -> None:
     """Forget the cached probe (tests, backend switches)."""
-    global _link_gbps, _hbm_high_water
+    global _link_gbps, _hbm_high_water, _link_probe_mb
     with _link_lock:
         _link_gbps = None
+        _link_probe_mb = None
     with _hbm_lock:
         _hbm_high_water = 0.0
 

@@ -113,12 +113,29 @@ def shard_owner(key: str, hosts: int) -> int:
     return int(key[:8], 16) % max(1, hosts)
 
 
+def _active_init_dtype():
+    """The low-precision transport dtype of the CURRENT config — the
+    warm must build (and fingerprint) the exact programs a consumer
+    under the same config will request (docs/performance.md
+    §transport)."""
+    from .. import config as tdx_config
+    from ..jax_bridge import transport
+
+    return transport.resolve_init_dtype(
+        tdx_config.get().materialize_init_dtype
+    )
+
+
 def _spec_for(name: str, idxs: List[int], fake_list, out_shardings,
-              param_dtype, mask, registry_dir: Optional[str]) -> ProgramSpec:
+              param_dtype, mask, registry_dir: Optional[str],
+              init_dtype=None) -> ProgramSpec:
     from ..jax_bridge import materialize as mat
 
+    tplan = mat._transport_plan(fake_list, idxs, out_shardings,
+                                param_dtype, mask, init_dtype)
     fp = mat._registry_program_fp(
-        fake_list, idxs, out_shardings, param_dtype, mask
+        fake_list, idxs, out_shardings, param_dtype, mask,
+        tplan.fp_material() if tplan is not None else None,
     )
     rk = registry_key(fp) if (fp and registry_dir) else None
     return ProgramSpec(name, list(idxs), fp, rk)
@@ -128,14 +145,15 @@ def plan_group_specs(fake_list, out_shardings, param_dtype, mask,
                      registry_dir: Optional[str]) -> List[ProgramSpec]:
     """The per-group program specs the pipelined engine will request for
     this recording under the current config — same split policy, same
-    shardings, same cast masks (host-independent by contract, exactly
-    like ``lower_init_groups``)."""
+    shardings, same cast masks and transport storage dtypes
+    (host-independent by contract, exactly like ``lower_init_groups``)."""
     from ..jax_bridge import materialize as mat
 
+    init_dtype = _active_init_dtype()
     bins = mat._plan_pipeline(fake_list) or []
     return [
         _spec_for(f"group-{gi}", idxs, fake_list, out_shardings,
-                  param_dtype, mask, registry_dir)
+                  param_dtype, mask, registry_dir, init_dtype)
         for gi, idxs in enumerate(bins)
     ]
 
@@ -207,6 +225,13 @@ def warm_sharded(factory, cache_dir: str, *,
             fn = mat._cast_outputs(
                 fn, param_dtype, [mask[i] for i in spec.idxs]
             )
+        from ..jax_bridge import transport
+
+        fn = transport.wrap_storage(
+            fn,
+            mat._transport_plan(fake_list, spec.idxs, out_shardings,
+                                param_dtype, mask, _active_init_dtype()),
+        )
         osh = (
             tuple(out_shardings[i] for i in spec.idxs)
             if out_shardings is not None else None
@@ -268,6 +293,7 @@ def warm_sharded(factory, cache_dir: str, *,
                 whole = _spec_for(
                     "whole", list(range(len(fake_list))), fake_list,
                     out_shardings, param_dtype, mask, registry_dir,
+                    _active_init_dtype(),
                 )
                 if owned(whole):
                     run_spec(whole)

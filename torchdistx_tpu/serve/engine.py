@@ -560,6 +560,24 @@ def spin_up_replica(
         assert init.name == "init"
         compiled, init_outcome = compile_serving_program(init)
         values = compiled()
+        if init.tplan is not None:
+            # Low-precision transport (TDX_MATERIALIZE_INIT_DTYPE): the
+            # init program delivered eligible params in the init dtype;
+            # upcast them on device to the contract dtypes the lowered
+            # prefill/decode signatures expect (donated staging buffers,
+            # same retry contract as the materialization engines).
+            from .. import config as _tdx_config
+            from ..jax_bridge import transport as _transport
+            from ..jax_bridge.materialize import _retryable_errors
+
+            cfg_eff = _tdx_config.get()
+            values, _donated = _transport.commit_outputs(
+                values, init.tplan,
+                donate=cfg_eff.materialize_donate,
+                producer=lambda: compiled(),
+                retries=max(0, cfg_eff.materialize_retries),
+                retryable=_retryable_errors(),
+            )
         params = jax.tree.unflatten(init.treedef, list(values))
         jax.block_until_ready(values)
         engine = ServeEngine(

@@ -350,6 +350,11 @@ class ServeProgramSpec:
     program_fp: str
     init_options: bool             # init compiler effort vs serving default
     treedef: Any = None            # init only: unflatten spec for params
+    # init only: the low-precision transport plan when
+    # TDX_MATERIALIZE_INIT_DTYPE is armed — the compiled init program
+    # then delivers eligible params in the init dtype and the bring-up
+    # upcasts them on device (jax_bridge.transport.commit_outputs).
+    tplan: Any = None
 
 
 def _fp(kind: str, family: str, cfg: TransformerConfig,
@@ -381,25 +386,34 @@ def _mesh_desc(mesh) -> str:
 
 
 def _abstract_params(family, cfg, *, seed, sample_len, param_dtype,
-                     mesh, plan):
+                     mesh, plan, init_dtype=None):
     """(init run_fn, init out_shardings, params treedef, abstract params
-    pytree) — the deferred-init thunk and the ShapeDtypeStruct tree the
-    prefill/decode programs are lowered against (cast policy and planned
-    shardings applied, so the lowered signature matches the arrays the
-    init program will actually deliver)."""
+    pytree, transport plan) — the deferred-init thunk and the
+    ShapeDtypeStruct tree the prefill/decode programs are lowered
+    against (cast policy and planned shardings applied, so the lowered
+    signature matches the arrays the init program will actually
+    deliver).  With ``init_dtype`` the init program stores eligible
+    params in the init dtype and the returned
+    :class:`..jax_bridge.transport.TransportPlan` describes the
+    on-device upcast the bring-up must run — the ShapeDtypeStructs keep
+    the POST-upcast contract dtypes, which is what the prefill/decode
+    programs consume."""
     model = make_model(family, cfg)
     sample = jnp.zeros((1, sample_len), jnp.int32)
     fakes = abstract.deferred_init(
         model.init, jax.random.PRNGKey(seed), sample
     )
     run_fn, out_shardings, treedef = abstract.materialize_parts(
-        fakes, mesh=mesh, plan=plan, param_dtype=param_dtype
+        fakes, mesh=mesh, plan=plan, param_dtype=param_dtype,
+        init_dtype=init_dtype,
     )
     leaves = jax.tree.leaves(fakes, is_leaf=abstract.is_fake)
     sds = []
+    elig = []
     for i, f in enumerate(leaves):
         dt = f.dtype
-        if param_dtype is not None and abstract._cast_eligible(f, f._thunk):
+        elig.append(abstract._cast_eligible(f, f._thunk))
+        if param_dtype is not None and elig[-1]:
             dt = param_dtype
         if out_shardings is not None:
             sds.append(jax.ShapeDtypeStruct(f.shape, dt,
@@ -407,7 +421,14 @@ def _abstract_params(family, cfg, *, seed, sample_len, param_dtype,
         else:
             sds.append(jax.ShapeDtypeStruct(f.shape, dt))
     params_abs = jax.tree.unflatten(treedef, sds)
-    return run_fn, out_shardings, treedef, params_abs
+    tplan = None
+    if init_dtype is not None:
+        from ..jax_bridge import transport
+
+        tplan = transport.plan_transport(
+            [s.dtype for s in sds], elig, init_dtype, out_shardings
+        )
+    return run_fn, out_shardings, treedef, params_abs, tplan
 
 
 def serve_program_specs(
@@ -429,9 +450,15 @@ def serve_program_specs(
     demand — same builders, same fingerprints, so a warmed registry
     makes bring-up all-hit."""
     scfg = (serve_cfg or ServeConfig()).resolve(cfg)
-    run_fn, out_shardings, treedef, params_abs = _abstract_params(
+    from ..jax_bridge import transport
+
+    init_dtype = transport.resolve_init_dtype(
+        tdx_config.get().materialize_init_dtype
+    )
+    run_fn, out_shardings, treedef, params_abs, tplan = _abstract_params(
         family, cfg, seed=seed, sample_len=sample_len,
         param_dtype=param_dtype, mesh=mesh, plan=plan,
+        init_dtype=init_dtype,
     )
     kv = scfg.kv_config(cfg)
     pool_sds = jax.ShapeDtypeStruct(kv.pool_shape(), cfg.dtype)
@@ -449,14 +476,23 @@ def serve_program_specs(
     )
     extra = (seed, sample_len, str(param_dtype), _mesh_desc(mesh),
              shard_desc)
+    # The low-precision transport changes the compiled init program (and
+    # under tolerance its values): its fingerprint must never collide
+    # with the default path's.  Salted only when a plan is ACTIVE, so
+    # default-config fingerprints — and every registry warmed with them
+    # — stay byte-stable.
+    init_extra = (
+        extra + (("init_dtype", str(init_dtype)),)
+        if tplan is not None else extra
+    )
 
     specs: List[ServeProgramSpec] = []
     if include_init:
         specs.append(ServeProgramSpec(
             name="init", fn=run_fn, args=(),
             out_shardings=out_shardings,
-            program_fp=_fp("init", family, cfg, scfg, extra),
-            init_options=True, treedef=treedef,
+            program_fp=_fp("init", family, cfg, scfg, init_extra),
+            init_options=True, treedef=treedef, tplan=tplan,
         ))
     for b in (buckets if buckets is not None else scfg.prefill_buckets):
         specs.append(ServeProgramSpec(
