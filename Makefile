@@ -124,6 +124,19 @@ bench-smoke:
 	              'gbps', r.get('materialize_gbps'), \
 	              'link_util', u, \
 	              'overlap', r.get('transfer_overlap'))"
+	JAX_PLATFORMS=cpu TDX_BENCH_PLATFORM=cpu TDX_SCHED_SHAPES=pp2_v2 \
+	    TDX_SCHED_PARITY=1 TDX_SCHED_SEGMENTS=0 timeout -k 10 540 \
+	    python bench.py --phase schedule_measured | tail -1 \
+	    | python -c "import json,math,sys; \
+	        r=json.load(sys.stdin)['schedule_measured']; \
+	        s=r['shapes']['pp2_v2']; \
+	        assert s.get('parity_bitwise') is True, s; \
+	        mva=s.get('measured_vs_analytic'); \
+	        assert mva is not None and math.isfinite(mva) and mva > 0, s; \
+	        print('schedule_measured OK:', \
+	              'parity_bitwise', s['parity_bitwise'], \
+	              'measured_vs_analytic', mva, \
+	              'seg_vs_uniform', s.get('segmented_vs_uniform'))"
 
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,

@@ -6,6 +6,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable, Optional, Tuple
 
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ._shard_map_compat import shard_map
@@ -22,6 +23,7 @@ def wrap_seq_parallel_attn(
     validate: Optional[Callable] = None,  # (q, k, v) -> None, raises on misuse
     bias_spec: Optional[P] = None,  # how [H, S_q, S_k] bias shards, or None
     seg_specs: Optional[Tuple[P, P]] = None,  # (q_seg, kv_seg) sharding
+    index_axis: Optional[str] = None,  # feed per_device a sharded ring index
 ):
     """Build a model-facing ``AttnFn`` that shard_maps ``per_device``.
 
@@ -33,11 +35,21 @@ def wrap_seq_parallel_attn(
     ``segment_ids`` — normalized to a ``(q_seg [B, S], kv_seg [B, T])``
     pair — are partitioned by ``seg_specs``.  Strategies that cannot
     reshard an operand leave its spec ``None`` and reject it.
+
+    ``index_axis`` (opt-in): prepend a ``P(index_axis)``-sharded iota so
+    ``per_device`` receives its ring position as a [1] array argument
+    (``idx=``) instead of calling ``lax.axis_index``.  On jax 0.4.x +
+    XLA:CPU the partition-id HLO that ``axis_index`` lowers to is left
+    without a manual-sharding annotation whenever its only consumers sit
+    inside a while-loop carry (sharding propagation does not look back
+    through the loop), and the SPMD partitioner rejects the bare
+    instruction — the sharded-iota input never emits partition-id at all.
     """
 
     def _build(causal: bool, with_bias: bool, with_segs: bool):
         in_specs = (
-            (spec, spec, spec)
+            ((P(index_axis),) if index_axis is not None else ())
+            + (spec, spec, spec)
             + ((bias_spec,) if with_bias else ())
             + (seg_specs if with_segs else ())
         )
@@ -49,10 +61,15 @@ def wrap_seq_parallel_attn(
             out_specs=spec,
             check_vma=False,
         )
-        def _sharded(q, k, v, *extras):
-            extras = list(extras)
+        def _sharded(*args):
+            args = list(args)
+            idx = args.pop(0) if index_axis is not None else None
+            q, k, v = args[:3]
+            extras = args[3:]
             bias = extras.pop(0) if with_bias else None
             segs = tuple(extras) if with_segs else None
+            if index_axis is not None:
+                return per_device(q, k, v, causal, bias, segs, idx=idx)
             return per_device(q, k, v, causal, bias, segs)
 
         return _sharded
@@ -75,6 +92,8 @@ def wrap_seq_parallel_attn(
         if key not in fns:
             fns[key] = _build(*key)
         args = (q, k, v)
+        if index_axis is not None:
+            args = (jnp.arange(mesh.shape[index_axis], dtype=jnp.int32),) + args
         if bias is not None:
             args += (bias,)
         if segs is not None:

@@ -43,6 +43,61 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 
+@dataclass(frozen=True)
+class Segment:
+    """A contiguous run of ticks sharing one statically-known work
+    archetype — the UNION of per-device activity over the run, because
+    the SPMD executor's program is identical on every device: a tick
+    where any device does backward work forces the backward body on all
+    of them (the inactive ones mask their accumulations).
+
+    The archetype decides which tick body the executor traces for the
+    run: ``fwd-only`` ticks pay no vjp, ``bwd-only`` ticks no forward
+    chain, and only ``fwd+bwd-seed`` ticks carry the head-loss
+    ``lax.cond``."""
+
+    t0: int
+    t1: int
+    has_f: bool       # any device runs a chunk forward in [t0, t1)
+    has_b: bool       # any device runs a chunk backward
+    has_seed: bool    # any backward self-seeds (head+loss vjp)
+    has_f_arr: bool   # any activation ppermute arrival lands
+    has_b_arr: bool   # any cotangent ppermute arrival lands
+
+    @property
+    def ticks(self) -> int:
+        return self.t1 - self.t0
+
+    @property
+    def archetype(self) -> str:
+        if self.has_f and self.has_b:
+            return "fwd+bwd-seed" if self.has_seed else "fwd+bwd-mid"
+        if self.has_f:
+            return "fwd-only"
+        if self.has_b:
+            return "bwd-only"
+        return "idle"  # pragma: no cover - schedules never emit idle runs
+
+    @property
+    def role(self) -> str:
+        """Observability name: fwd-only runs are the pipeline fill
+        ("warmup"), mixed runs the steady state, bwd-only the drain
+        ("cooldown")."""
+        return {"fwd-only": "warmup", "fwd+bwd-seed": "steady",
+                "fwd+bwd-mid": "steady", "bwd-only": "cooldown",
+                "idle": "idle"}[self.archetype]
+
+
+# Analytic per-tick cost units (in chunk-forward equivalents) shared by
+# the static model, the bench's ``measured_vs_analytic`` headline, and
+# docs/performance.md §The schedule executor.  A recompute backward
+# (the fused executors re-run the chunk interior under jax.vjp) costs a
+# forward more than a stored-residual backward (GPipe's jax.grad).
+FWD_UNIT = 1.0
+BWD_STORED_UNIT = 2.0
+BWD_RECOMPUTE_UNIT = 3.0
+
+
 @dataclass
 class InterleavedSchedule:
     """Static tables for the SPMD executor; all arrays are int32 with
@@ -87,6 +142,51 @@ class InterleavedSchedule:
             "b_mb": self.b_mb, "b_rd": self.b_rd, "stash_r": self.stash_r,
             "f_arr": self.f_arr, "b_arr": self.b_arr,
         }
+
+    def segments(self) -> List[Segment]:
+        """Partition ``[0, T)`` into maximal contiguous runs whose
+        (any-forward, any-backward) union archetype is constant, with
+        per-run arrival/seed flags read off the tables.  The phase-
+        specialized executor traces ONE tick body per run and runs it as
+        its own ``lax.fori_loop`` — see docs/performance.md §The
+        schedule executor.  Every realizable schedule collapses to the
+        classic warmup → steady → cooldown shape (asserted by
+        tests/test_interleave.py over the pp×v×m sweep), but the merge
+        is generic so a future dispatcher change degrades to more
+        segments, not wrong ones."""
+        any_f = (self.f_loc >= 0).any(axis=0)
+        any_b = (self.b_loc >= 0).any(axis=0)
+        segs: List[Segment] = []
+        t0 = 0
+        for t in range(1, self.T + 1):
+            if t == self.T or (any_f[t], any_b[t]) != (any_f[t0], any_b[t0]):
+                sl = slice(t0, t)
+                segs.append(Segment(
+                    t0=t0, t1=t,
+                    has_f=bool(any_f[t0]), has_b=bool(any_b[t0]),
+                    has_seed=bool(
+                        ((self.b_loc[:, sl] >= 0)
+                         & (self.b_rd[:, sl] < 0)).any()
+                    ),
+                    has_f_arr=bool((self.f_arr[:, sl] >= 0).any()),
+                    has_b_arr=bool((self.b_arr[:, sl] >= 0).any()),
+                ))
+                t0 = t
+        return segs
+
+    def analytic_step_units(self) -> float:
+        """Predicted cost of one step under the phase-specialized
+        executor, in chunk-forward units: each tick of a run pays only
+        its archetype's work (every device, active or masked — SPMD)."""
+        return sum(
+            s.ticks * (s.has_f * FWD_UNIT + s.has_b * BWD_RECOMPUTE_UNIT)
+            for s in self.segments()
+        )
+
+    def uniform_step_units(self) -> float:
+        """Predicted cost of the uniform-tick executor: all ``T`` ticks
+        pay forward + recompute-backward regardless of activity."""
+        return self.T * (FWD_UNIT + BWD_RECOMPUTE_UNIT)
 
 
 class _SlotPool:
@@ -288,14 +388,36 @@ def interleaved_schedule(pp: int, v: int, m: int) -> InterleavedSchedule:
     # guarantee it, and tests/test_interleave.py asserts it.
 
     busy = int((f_loc >= 0).sum() + (b_loc >= 0).sum())
+    n_f = max((p.n for p in fpool), default=0) or 1
+    n_b = max((p.n for p in bpool), default=0) or 1
+    n_s = max((p.n for p in spool), default=0) or 1
+    # Build-time guards (cheap numpy): every ACTIVE read/write index
+    # lands strictly in-bounds of its buffer.  The executor's jnp.clip
+    # at the corresponding read sites therefore only ever rewrites the
+    # -1 of an INACTIVE (masked) op — it is a trace-shape guard, never a
+    # correctness device; tests/test_interleave.py proves the same over
+    # the pp×v×m sweep.
+    for name, tab, n_slots, active in [
+        ("f_rd", f_rd, n_f, f_loc >= 0), ("f_arr", f_arr, n_f, f_arr >= 0),
+        ("b_rd", b_rd, n_b, b_loc >= 0), ("b_arr", b_arr, n_b, b_arr >= 0),
+        ("stash_w", stash_w, n_s, f_loc >= 0),
+        ("stash_r", stash_r, n_s, b_loc >= 0),
+    ]:
+        # f_rd/b_rd stay -1 for batch feeds / self-seeds — those are
+        # active ops whose table value is legitimately negative.
+        vals = tab[active]
+        if name in ("f_rd", "b_rd"):
+            vals = vals[vals >= 0]
+        assert vals.size == 0 or (0 <= vals.min() and vals.max() < n_slots), (
+            f"interleaved_schedule({pp}, {v}, {m}): {name} has an active "
+            f"index outside [0, {n_slots})"
+        )
     sched = InterleavedSchedule(
         pp=pp, v=v, m=m, T=T,
         f_loc=f_loc, f_mb=f_mb, f_rd=f_rd, stash_w=stash_w,
         b_loc=b_loc, b_mb=b_mb, b_rd=b_rd, stash_r=stash_r,
         f_arr=f_arr, b_arr=b_arr,
-        n_f_slots=max((p.n for p in fpool), default=0) or 1,
-        n_b_slots=max((p.n for p in bpool), default=0) or 1,
-        n_stash_slots=max((p.n for p in spool), default=0) or 1,
+        n_f_slots=n_f, n_b_slots=n_b, n_stash_slots=n_s,
         bubble_fraction=round(1.0 - busy / (2.0 * pp * T), 4),
         peak_stash=max(p.peak for p in spool),
     )
@@ -308,3 +430,37 @@ def flat_1f1b_ticks(pp: int, m: int) -> int:
     bubble comparison against :func:`interleaved_schedule` (whose ticks
     are ``1/v`` the work), scale by ``v``."""
     return 2 * (pp - 1) + m
+
+
+def flat_1f1b_segments(pp: int, m: int) -> List[Segment]:
+    """Closed-form segments of the flat 1F1B schedule: ``pp-1`` warmup
+    ticks where only forwards run (the first backward is the last
+    stage's tick-``pp-1`` self-seed), ``m`` steady ticks (every one of
+    which seeds — the last stage backs up one microbatch per tick), and
+    ``pp-1`` drain ticks with forwards exhausted.  Arrival flags are
+    meaningless for the flat executor (it has single-slot ring buffers,
+    not inboxes) and are stamped to mirror the work flags."""
+    n1 = pp - 1
+    segs = [
+        Segment(0, n1, True, False, False, n1 > 1, False),
+        Segment(n1, n1 + m, True, True, True, True, True),
+        Segment(n1 + m, 2 * n1 + m, False, True, False, False, n1 > 1),
+    ]
+    return [s for s in segs if s.ticks > 0]
+
+
+def analytic_step_units_flat(pp: int, v: int, m: int) -> float:
+    """Phase-specialized flat-1F1B step cost in chunk-forward units
+    (one flat tick runs the whole ``v``-chunk device stack)."""
+    return v * sum(
+        s.ticks * (s.has_f * FWD_UNIT + s.has_b * BWD_RECOMPUTE_UNIT)
+        for s in flat_1f1b_segments(pp, m)
+    )
+
+
+def analytic_step_units_gpipe(pp: int, v: int, m: int) -> float:
+    """GPipe step cost in chunk-forward units: ``m + pp - 1`` forward
+    ticks of the full device stack, transposed by ``jax.grad`` into the
+    same count of stored-residual backward ticks (no recompute — GPipe
+    keeps every microbatch's layer activations, its memory price)."""
+    return (m + pp - 1) * v * (FWD_UNIT + BWD_STORED_UNIT)

@@ -22,6 +22,7 @@ Entry points:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Optional
 
@@ -32,9 +33,55 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ._shard_map_compat import shard_map
 
+from .. import observe
 from ..models.configs import TransformerConfig
 from ..models.layers import Block, default_attention
 from .collectives import ring_next, ring_prev, send_next, send_prev
+
+# The fused (1F1B-family) schedules ship two executors (docs/
+# performance.md §The schedule executor):
+#
+# * ``"segmented"`` (default) — phase-specialized: the tick table is
+#   partitioned at build time into contiguous warmup / steady / cooldown
+#   runs with statically-known archetypes, and each run is its own
+#   ``lax.fori_loop`` whose body contains ONLY that archetype's work
+#   (warmup ticks pay no backward vjp, drain ticks no forward chain, and
+#   the head-loss ``lax.cond`` exists only where a seed can occur).  The
+#   ring send of a tick's activations is issued straight after the
+#   forward so XLA can overlap the ppermute with the same tick's
+#   backward half (double buffering).
+# * ``"uniform"`` — the historical single-loop executor: every tick runs
+#   the full forward chain AND the full backward vjp with inactive work
+#   discarded through masks.  Kept as the bitwise-parity baseline (the
+#   segmented executor must reproduce its five outputs exactly —
+#   tests/test_parallel.py, tests/test_interleave.py) and as the bench
+#   A/B (`bench.py --phase schedule_measured`).
+_EXECUTORS = ("segmented", "uniform")
+
+
+def _resolve_executor(executor: Optional[str]) -> str:
+    ex = executor or os.environ.get("TDX_PP_EXECUTOR", "segmented")
+    if ex not in _EXECUTORS:
+        raise ValueError(
+            f"pipeline executor must be one of {_EXECUTORS}, got {ex!r} "
+            f"(TDX_PP_EXECUTOR overrides the default)"
+        )
+    return ex
+
+
+def _note_schedule_segments(segs, label: str) -> None:
+    """Publish the segment layout as ``tdx.pp.*`` gauges (docs/
+    observability.md §counters) — trace-time, once per compile."""
+    if not observe.enabled():
+        return
+    roles = {"warmup": 0, "steady": 0, "cooldown": 0}
+    for s in segs:
+        roles[s.role] = roles.get(s.role, 0) + s.ticks
+    g = observe.counters().gauge
+    g("tdx.pp.warmup_ticks", schedule=label).set(roles["warmup"])
+    g("tdx.pp.steady_ticks", schedule=label).set(roles["steady"])
+    g("tdx.pp.cooldown_ticks", schedule=label).set(roles["cooldown"])
+    g("tdx.pp.segments", schedule=label).set(len(segs))
 
 
 def _sum_aux(tree) -> jax.Array:
@@ -75,6 +122,7 @@ def pipeline_forward(
     seg_mb: Optional[jax.Array] = None,  # [n_mb, mb, S] packed ids
     *,
     axis_name: str = "pp",
+    stage_arr: Optional[jax.Array] = None,  # [1] per-shard stage id
 ):
     """Run the GPipe schedule; call inside ``shard_map`` over ``axis_name``.
 
@@ -93,7 +141,11 @@ def pipeline_forward(
     semantics every gradient-accumulating trainer uses).
     """
     n = lax.psum(1, axis_name)
-    stage = lax.axis_index(axis_name)
+    # ``stage_arr`` (a P(axis_name)-sharded iota) sidesteps the jax
+    # 0.4.x partition-id lowering that XLA's SPMD partitioner rejects
+    # under a partial-manual shard_map (see pipeline_train_1f1b);
+    # axis_index stays as the fallback for full-manual callers.
+    stage = stage_arr[0] if stage_arr is not None else lax.axis_index(axis_name)
     n_mb = x_mb.shape[0]
     total = n_mb + n - 1
     has_segs = seg_mb is not None
@@ -211,14 +263,25 @@ def pipelined_decoder_apply(
     )
 
     pp_fn = shard_map(
-        partial(pipeline_forward, chain, axis_name=axis_name),
+        lambda sid, sp, xm, sm: pipeline_forward(
+            chain, sp, xm, sm, axis_name=axis_name, stage_arr=sid
+        ),
         mesh=mesh,
-        in_specs=(P(axis_name), P(), P()),
+        in_specs=(P(axis_name), P(axis_name), P(), P()),
         out_specs=(P(), P()),
-        axis_names={axis_name},
+        # Full-manual over every mesh axis: the partial-manual mode
+        # (axis_names={axis_name}, dp left auto) dies in XLA's SPMD
+        # partitioner on this jax/XLA pair — an unannotated
+        # partition-id HLO at best, a manual-subgroup CHECK crash at
+        # worst.  Under full-manual the dp groups run identical
+        # replicated compute, which is what the auto annotations
+        # declared anyway.
         check_vma=False,
     )
-    y, aux = pp_fn(decomp.block_params(p), x_mb, seg_mb)
+    y, aux = pp_fn(
+        jnp.arange(mesh.shape[axis_name], dtype=jnp.int32),
+        decomp.block_params(p), x_mb, seg_mb,
+    )
     x = y.reshape(B, S, cfg.d_model)
 
     # final norm + head (replicated compute)
@@ -331,6 +394,8 @@ def pipeline_train_1f1b(
     axis_name: str = "pp",
     attn_fn=default_attention,
     segment_ids: Optional[jax.Array] = None,
+    executor: Optional[str] = None,
+    _run_segments: Optional[int] = None,
 ):
     """Fused forward+backward pipeline step under the 1F1B schedule.
 
@@ -359,16 +424,37 @@ def pipeline_train_1f1b(
 
     The loss is the exact full-batch mean CE (see :func:`_mb_ce_sum`)
     plus the microbatch-averaged aux, so metrics match the GPipe path.
+
+    ``executor`` picks the loop structure (``"segmented"`` /
+    ``"uniform"``, see :data:`_EXECUTORS`); both produce bitwise-equal
+    outputs.  ``_run_segments`` (segmented only) truncates the schedule
+    to its first ``k`` segments — a bench hook for per-segment wall
+    timing by differencing, NOT a training API (the outputs of a
+    truncated run are partial accumulators).
     """
+    from .interleave import flat_1f1b_segments
+
+    executor = _resolve_executor(executor)
     su = _FusedSetup(cfg, params, tokens, decomp, n_microbatches,
                      attn_fn, segment_ids)
     n_mb = su.n_mb
     p, p_light, chain, head_loss = su.p, su.p_light, su.chain, su.head_loss
     x_mb, tok_mb, seg_mb, has_segs = su.x_mb, su.tok_mb, su.seg_mb, su.has_segs
+    pp = mesh.shape[axis_name]
+    flat_segs = flat_1f1b_segments(pp, n_mb)
+    if executor == "segmented":
+        _note_schedule_segments(flat_segs, "1f1b")
 
-    def schedule(stacked, q_light, x_mb, tok_mb, seg_mb):
+    def schedule(stage_arr, stacked, q_light, x_mb, tok_mb, seg_mb):
         n = lax.psum(1, axis_name)
-        stage = lax.axis_index(axis_name)
+        # Stage id arrives as a P(pp)-sharded iota instead of
+        # lax.axis_index: under the partial-manual shard_map (dp stays
+        # auto) jax 0.4.x leaves axis_index's partition-id HLO without a
+        # sharding annotation and XLA's SPMD partitioner rejects the
+        # module ("PartitionId instruction is not supported for SPMD
+        # partitioning") — the cause of the long-standing tier-1
+        # PartitionId failures.  A sharded input needs no partitioning.
+        stage = stage_arr[0]
         is_last = stage == n - 1
         T = 2 * (n - 1) + n_mb
         # Circular input stash: stage s needs microbatch i's input from
@@ -380,10 +466,8 @@ def pipeline_train_1f1b(
         # x_mb input itself.)
         W = min(n_mb, 2 * (n - 1) + 1)
 
-        def tick(t, carry):
-            buf, dbuf, stash, g_blk, g_light, dx_out, ce_acc, aux_acc = carry
-
-            # ---- forward: microbatch f = t - stage -----------------------
+        def fwd_half(t, buf, stash, aux_acc):
+            # ---- forward: microbatch f = t - stage ----------------------
             f = t - stage
             do_f = (f >= 0) & (f < n_mb)
             fi = jnp.clip(f, 0, n_mb - 1)
@@ -393,34 +477,49 @@ def pipeline_train_1f1b(
             slot_f = fi % W
             stash = stash.at[slot_f].set(jnp.where(do_f, inp, stash[slot_f]))
             aux_acc = aux_acc + jnp.where(do_f, aux, 0.0)
+            # Ring send issued straight after the forward (double
+            # buffering): the ppermute has no data dependency on the
+            # backward half below, so the transfer of tick t's
+            # activations overlaps tick t's backward compute.
+            buf = send_next(y, axis_name)
+            return y, buf, stash, aux_acc
 
-            # ---- backward: microbatch b = t - (2(n-1) - stage) -----------
+        def bwd_half(t, y, carry_b, *, seed):
+            dbuf, stash, g_blk, g_light, dx_out, ce_acc = carry_b
+            # ---- backward: microbatch b = t - (2(n-1) - stage) ----------
             b = t - (2 * (n - 1) - stage)
             do_b = (b >= 0) & (b < n_mb)
             bi = jnp.clip(b, 0, n_mb - 1)
             segs_b = seg_mb[bi] if has_segs else None
 
-            def seed_last(_):
-                # b == f at the last stage: head+loss on this tick's y.
-                ce, hvjp = jax.vjp(
-                    lambda q, yy: head_loss(q, yy, tok_mb[bi], segs_b),
-                    q_light, y,
-                )
-                dq, dy = hvjp(jnp.float32(1.0))
-                return ce, dy.astype(y.dtype), dq
+            if seed:
+                def seed_last(_):
+                    # b == f at the last stage: head+loss on this tick's y.
+                    ce, hvjp = jax.vjp(
+                        lambda q, yy: head_loss(q, yy, tok_mb[bi], segs_b),
+                        q_light, y,
+                    )
+                    dq, dy = hvjp(jnp.float32(1.0))
+                    return ce, dy.astype(y.dtype), dq
 
-            def seed_mid(_):
-                return (
-                    jnp.float32(0.0),
-                    dbuf,
-                    jax.tree.map(jnp.zeros_like, q_light),
-                )
+                def seed_mid(_):
+                    return (
+                        jnp.float32(0.0),
+                        dbuf,
+                        jax.tree.map(jnp.zeros_like, q_light),
+                    )
 
-            ce_j, dy, dq = lax.cond(is_last, seed_last, seed_mid, None)
-            ce_acc = ce_acc + jnp.where(do_b, ce_j, 0.0)
-            g_light = jax.tree.map(
-                lambda a, g: a + jnp.where(do_b, g, 0), g_light, dq
-            )
+                ce_j, dy, dq = lax.cond(is_last, seed_last, seed_mid, None)
+                ce_acc = ce_acc + jnp.where(do_b, ce_j, 0.0)
+                g_light = jax.tree.map(
+                    lambda a, g: a + jnp.where(do_b, g, 0), g_light, dq
+                )
+            else:
+                # Seed-free segment (the drain): every active backward
+                # consumes a rotated cotangent; ce/g_light untouched
+                # (the uniform executor adds exact +0.0 here, which is
+                # bitwise-identity on accumulators built from +0.0).
+                dy = dbuf
 
             # Recompute the stage interior and pull gradients through it;
             # the aux output's cotangent is 1/n_mb (microbatch average).
@@ -434,13 +533,25 @@ def pipeline_train_1f1b(
             dx_out = dx_out.at[bi].set(
                 jnp.where(do_b & (stage == 0), dx, dx_out[bi])
             )
-
-            # ---- rotate: activations forward, cotangents backward --------
-            buf = send_next(y, axis_name)
             dbuf = send_prev(dx, axis_name)
-            return (buf, dbuf, stash, g_blk, g_light, dx_out, ce_acc, aux_acc)
+            return (dbuf, stash, g_blk, g_light, dx_out, ce_acc)
 
-        carry0 = (
+        def make_tick(has_f: bool, has_b: bool, has_seed: bool):
+            def tick(t, carry):
+                buf, dbuf, stash, g_blk, g_light, dx_out, ce_acc, aux_acc = carry
+                y = None
+                if has_f:
+                    y, buf, stash, aux_acc = fwd_half(t, buf, stash, aux_acc)
+                if has_b:
+                    dbuf, stash, g_blk, g_light, dx_out, ce_acc = bwd_half(
+                        t, y, (dbuf, stash, g_blk, g_light, dx_out, ce_acc),
+                        seed=has_seed,
+                    )
+                return (buf, dbuf, stash, g_blk, g_light, dx_out,
+                        ce_acc, aux_acc)
+            return tick
+
+        carry = (
             jnp.zeros_like(x_mb[0]),
             jnp.zeros_like(x_mb[0]),
             jnp.zeros((W, *x_mb.shape[1:]), x_mb.dtype),
@@ -450,9 +561,21 @@ def pipeline_train_1f1b(
             jnp.float32(0.0),
             jnp.float32(0.0),
         )
-        _, _, _, g_blk, g_light, dx_out, ce, aux = lax.fori_loop(
-            0, T, tick, carry0, unroll=False
-        )
+        if executor == "uniform":
+            carry = lax.fori_loop(
+                0, T, make_tick(True, True, True), carry, unroll=False
+            )
+        else:
+            segs = flat_segs
+            if _run_segments is not None:
+                segs = segs[:_run_segments]
+            for seg in segs:
+                carry = lax.fori_loop(
+                    seg.t0, seg.t1,
+                    make_tick(seg.has_f, seg.has_b, seg.has_seed),
+                    carry, unroll=False,
+                )
+        _, _, _, g_blk, g_light, dx_out, ce, aux = carry
         # Stage-local block grads stay sharded over pp (out_spec);
         # everything else reduces: head grads live on the last stage,
         # dx on stage 0, ce on the last stage, aux on all.
@@ -467,12 +590,19 @@ def pipeline_train_1f1b(
     pp_fn = shard_map(
         schedule,
         mesh=mesh,
-        in_specs=(P(axis_name), P(), P(), P(), P()),
+        in_specs=(P(axis_name), P(axis_name), P(), P(), P(), P()),
         out_specs=(P(axis_name), P(), P(), P(), P()),
-        axis_names={axis_name},
+        # Full-manual over every mesh axis: the partial-manual mode
+        # (axis_names={axis_name}, dp left auto) dies in XLA's SPMD
+        # partitioner on this jax/XLA pair — an unannotated
+        # partition-id HLO at best, a manual-subgroup CHECK crash at
+        # worst.  Under full-manual the dp groups run identical
+        # replicated compute, which is what the auto annotations
+        # declared anyway.
         check_vma=False,
     )
     g_blk, g_light, dx_out, ce, aux = pp_fn(
+        jnp.arange(pp, dtype=jnp.int32),
         decomp.block_params(p), p_light, x_mb, tok_mb, seg_mb
     )
     return su.finish(g_blk, g_light, dx_out, ce, aux)
@@ -522,6 +652,8 @@ def pipeline_train_interleaved(
     axis_name: str = "pp",
     attn_fn=default_attention,
     segment_ids: Optional[jax.Array] = None,
+    executor: Optional[str] = None,
+    _run_segments: Optional[int] = None,
 ):
     """Interleaved (virtual-stage) 1F1B: :func:`pipeline_train_1f1b`
     semantics with ``n_chunks`` model chunks per device (VERDICT r3 next
@@ -547,6 +679,7 @@ def pipeline_train_interleaved(
     """
     from .interleave import interleaved_schedule
 
+    executor = _resolve_executor(executor)
     su = _FusedSetup(cfg, params, tokens, decomp, n_microbatches,
                      attn_fn, segment_ids)
     n_mb = su.n_mb
@@ -556,11 +689,17 @@ def pipeline_train_interleaved(
     v = n_chunks
     sched = interleaved_schedule(pp, v, n_mb)
     tbl = {k: jnp.asarray(a) for k, a in sched.tables().items()}
+    sched_segs = sched.segments()
+    if executor == "segmented":
+        _note_schedule_segments(sched_segs, "interleaved")
     perm, inv = _interleave_perm(cfg.n_layers, pp, v)
     Lc = cfg.n_layers // (pp * v)
 
-    def schedule(stacked, q_light, x_mb, tok_mb, seg_mb):
-        stage = lax.axis_index(axis_name)
+    def schedule(stage_arr, stacked, q_light, x_mb, tok_mb, seg_mb):
+        # Sharded-iota stage id — see the pipeline_train_1f1b schedule
+        # for why lax.axis_index cannot be used under the
+        # partial-manual shard_map (jax 0.4.x PartitionId lowering).
+        stage = stage_arr[0]
         # Local chunk-major view: [v, Lc, ...] per param leaf.
         stacked_r = jax.tree.map(
             lambda a: a.reshape(v, Lc, *a.shape[1:]), stacked
@@ -568,89 +707,127 @@ def pipeline_train_interleaved(
         act_shape = x_mb.shape[1:]  # [mbs, S, d]
 
         def at_set(buf, slot, value, enabled):
+            # clip is a trace-shape guard only: slot is -1 exactly when
+            # ``enabled`` is false (the write is discarded), and every
+            # ENABLED slot is proven in-bounds at schedule build time
+            # (interleaved_schedule's table validation) and by the
+            # tests/test_interleave.py property sweep.
             i = jnp.clip(slot, 0, buf.shape[0] - 1)
             return buf.at[i].set(jnp.where(enabled, value, buf[i]))
 
-        def tick(t, carry):
-            (buf, dbuf, inbox_f, inbox_b, stash,
-             g_blk, g_light, dx_out, ce_acc, aux_acc) = carry
+        def make_tick(has_f: bool, has_b: bool, has_seed: bool,
+                      has_f_arr: bool, has_b_arr: bool):
+            """One tick body containing ONLY the given archetype's work;
+            ``make_tick(*[True]*5)`` is the uniform executor's body."""
+            # A seed backward consumes its own tick's forward output, so
+            # a seed-bearing segment always has forwards (schedule
+            # invariant: t(B(K-1, i)) == t(F(K-1, i))).
+            assert has_f or not has_seed
 
-            # ---- arrivals: what neighbours sent LAST tick --------------
-            inbox_f = at_set(inbox_f, tbl["f_arr"][stage, t], buf,
-                             tbl["f_arr"][stage, t] >= 0)
-            inbox_b = at_set(inbox_b, tbl["b_arr"][stage, t], dbuf,
-                             tbl["b_arr"][stage, t] >= 0)
+            def tick(t, carry):
+                (buf, dbuf, inbox_f, inbox_b, stash,
+                 g_blk, g_light, dx_out, ce_acc, aux_acc) = carry
 
-            # ---- forward ----------------------------------------------
-            floc = tbl["f_loc"][stage, t]
-            do_f = floc >= 0
-            fj = jnp.clip(floc, 0, v - 1)
-            fm = jnp.clip(tbl["f_mb"][stage, t], 0, n_mb - 1)
-            f_rd = tbl["f_rd"][stage, t]
-            inp = jnp.where(
-                f_rd < 0,  # only ever batch-feed (global chunk 0)
-                x_mb[fm],
-                inbox_f[jnp.clip(f_rd, 0, inbox_f.shape[0] - 1)],
-            )
-            segs_f = seg_mb[fm] if has_segs else None
-            sp_f = jax.tree.map(lambda a: a[fj], stacked_r)
-            y, aux = chain(sp_f, inp, segs_f)
-            stash = at_set(stash, tbl["stash_w"][stage, t], inp, do_f)
-            aux_acc = aux_acc + jnp.where(do_f, aux, 0.0)
+                # ---- arrivals: what neighbours sent LAST tick ----------
+                if has_f_arr:
+                    inbox_f = at_set(inbox_f, tbl["f_arr"][stage, t], buf,
+                                     tbl["f_arr"][stage, t] >= 0)
+                if has_b_arr:
+                    inbox_b = at_set(inbox_b, tbl["b_arr"][stage, t], dbuf,
+                                     tbl["b_arr"][stage, t] >= 0)
 
-            # ---- backward ---------------------------------------------
-            bloc = tbl["b_loc"][stage, t]
-            do_b = bloc >= 0
-            bj = jnp.clip(bloc, 0, v - 1)
-            bm = jnp.clip(tbl["b_mb"][stage, t], 0, n_mb - 1)
-            b_rd = tbl["b_rd"][stage, t]
-            is_seed = do_b & (b_rd < 0)
-            segs_b = seg_mb[bm] if has_segs else None
+                # ---- forward ------------------------------------------
+                y = None
+                if has_f:
+                    floc = tbl["f_loc"][stage, t]
+                    do_f = floc >= 0
+                    fj = jnp.clip(floc, 0, v - 1)
+                    fm = jnp.clip(tbl["f_mb"][stage, t], 0, n_mb - 1)
+                    f_rd = tbl["f_rd"][stage, t]
+                    inp = jnp.where(
+                        f_rd < 0,  # only ever batch-feed (global chunk 0)
+                        x_mb[fm],
+                        inbox_f[jnp.clip(f_rd, 0, inbox_f.shape[0] - 1)],
+                    )
+                    segs_f = seg_mb[fm] if has_segs else None
+                    sp_f = jax.tree.map(lambda a: a[fj], stacked_r)
+                    y, aux = chain(sp_f, inp, segs_f)
+                    stash = at_set(stash, tbl["stash_w"][stage, t], inp, do_f)
+                    aux_acc = aux_acc + jnp.where(do_f, aux, 0.0)
+                    # Ring send issued straight after the forward (double
+                    # buffering): no data dependency on the backward half,
+                    # so the ppermute overlaps this tick's backward.
+                    buf = ring_next(y, axis_name)
 
-            def seed_last(_):
-                ce, hvjp = jax.vjp(
-                    lambda q, yy: head_loss(q, yy, tok_mb[bm], segs_b),
-                    q_light, y,
-                )
-                dq, dy = hvjp(jnp.float32(1.0))
-                return ce, dy.astype(y.dtype), dq
+                # ---- backward -----------------------------------------
+                if has_b:
+                    bloc = tbl["b_loc"][stage, t]
+                    do_b = bloc >= 0
+                    bj = jnp.clip(bloc, 0, v - 1)
+                    bm = jnp.clip(tbl["b_mb"][stage, t], 0, n_mb - 1)
+                    b_rd = tbl["b_rd"][stage, t]
+                    segs_b = seg_mb[bm] if has_segs else None
 
-            def seed_mid(_):
-                return (
-                    jnp.float32(0.0),
-                    inbox_b[jnp.clip(b_rd, 0, inbox_b.shape[0] - 1)],
-                    jax.tree.map(jnp.zeros_like, q_light),
-                )
+                    if has_seed:
+                        is_seed = do_b & (b_rd < 0)
 
-            ce_j, dy, dq = lax.cond(is_seed, seed_last, seed_mid, None)
-            ce_acc = ce_acc + jnp.where(do_b, ce_j, 0.0)
-            g_light = jax.tree.map(
-                lambda a, g: a + jnp.where(do_b, g, 0), g_light, dq
-            )
+                        def seed_last(_):
+                            ce, hvjp = jax.vjp(
+                                lambda q, yy: head_loss(
+                                    q, yy, tok_mb[bm], segs_b),
+                                q_light, y,
+                            )
+                            dq, dy = hvjp(jnp.float32(1.0))
+                            return ce, dy.astype(y.dtype), dq
 
-            sp_b = jax.tree.map(lambda a: a[bj], stacked_r)
-            _, cvjp = jax.vjp(
-                lambda sp, xx: chain(sp, xx, segs_b),
-                sp_b,
-                stash[jnp.clip(tbl["stash_r"][stage, t], 0,
-                               stash.shape[0] - 1)],
-            )
-            d_sp, dx = cvjp((dy, jnp.float32(1.0 / n_mb)))
-            g_blk = jax.tree.map(
-                lambda a, g: a.at[bj].add(jnp.where(do_b, g, 0)),
-                g_blk, d_sp,
-            )
-            # global chunk 0's backward emits the embed cotangent
-            dx_out = dx_out.at[bm].set(
-                jnp.where(do_b & (stage == 0) & (bloc == 0), dx, dx_out[bm])
-            )
+                        def seed_mid(_):
+                            return (
+                                jnp.float32(0.0),
+                                inbox_b[jnp.clip(b_rd, 0,
+                                                 inbox_b.shape[0] - 1)],
+                                jax.tree.map(jnp.zeros_like, q_light),
+                            )
 
-            buf = ring_next(y, axis_name)
-            dbuf = ring_prev(dx, axis_name)
-            return (buf, dbuf, inbox_f, inbox_b, stash,
-                    g_blk, g_light, dx_out, ce_acc, aux_acc)
+                        ce_j, dy, dq = lax.cond(is_seed, seed_last,
+                                                seed_mid, None)
+                        ce_acc = ce_acc + jnp.where(do_b, ce_j, 0.0)
+                        g_light = jax.tree.map(
+                            lambda a, g: a + jnp.where(do_b, g, 0),
+                            g_light, dq
+                        )
+                    else:
+                        # Seed-free segment (the drain): every active
+                        # backward consumes a rotated cotangent;
+                        # ce/g_light untouched (the uniform executor
+                        # adds exact +0.0 — bitwise identity).
+                        dy = inbox_b[jnp.clip(b_rd, 0,
+                                              inbox_b.shape[0] - 1)]
 
-        carry0 = (
+                    sp_b = jax.tree.map(lambda a: a[bj], stacked_r)
+                    _, cvjp = jax.vjp(
+                        lambda sp, xx: chain(sp, xx, segs_b),
+                        sp_b,
+                        stash[jnp.clip(tbl["stash_r"][stage, t], 0,
+                                       stash.shape[0] - 1)],
+                    )
+                    d_sp, dx = cvjp((dy, jnp.float32(1.0 / n_mb)))
+                    g_blk = jax.tree.map(
+                        lambda a, g: a.at[bj].add(jnp.where(do_b, g, 0)),
+                        g_blk, d_sp,
+                    )
+                    # global chunk 0's backward emits the embed cotangent
+                    dx_out = dx_out.at[bm].set(
+                        jnp.where(do_b & (stage == 0) & (bloc == 0),
+                                  dx, dx_out[bm])
+                    )
+                    dbuf = ring_prev(dx, axis_name)
+
+                return (buf, dbuf, inbox_f, inbox_b, stash,
+                        g_blk, g_light, dx_out, ce_acc, aux_acc)
+
+            return tick
+
+        carry = (
             jnp.zeros(act_shape, x_mb.dtype),
             jnp.zeros(act_shape, x_mb.dtype),
             jnp.zeros((sched.n_f_slots, *act_shape), x_mb.dtype),
@@ -662,8 +839,23 @@ def pipeline_train_interleaved(
             jnp.float32(0.0),
             jnp.float32(0.0),
         )
-        out = lax.fori_loop(0, sched.T, tick, carry0, unroll=False)
-        (_, _, _, _, _, g_blk, g_light, dx_out, ce, aux) = out
+        if executor == "uniform":
+            carry = lax.fori_loop(
+                0, sched.T, make_tick(True, True, True, True, True),
+                carry, unroll=False,
+            )
+        else:
+            segs = sched_segs
+            if _run_segments is not None:
+                segs = segs[:_run_segments]
+            for seg in segs:
+                carry = lax.fori_loop(
+                    seg.t0, seg.t1,
+                    make_tick(seg.has_f, seg.has_b, seg.has_seed,
+                              seg.has_f_arr, seg.has_b_arr),
+                    carry, unroll=False,
+                )
+        (_, _, _, _, _, g_blk, g_light, dx_out, ce, aux) = carry
         g_blk = jax.tree.map(
             lambda a: a.reshape(v * Lc, *a.shape[2:]), g_blk
         )
@@ -678,14 +870,21 @@ def pipeline_train_interleaved(
     pp_fn = shard_map(
         schedule,
         mesh=mesh,
-        in_specs=(P(axis_name), P(), P(), P(), P()),
+        in_specs=(P(axis_name), P(axis_name), P(), P(), P(), P()),
         out_specs=(P(axis_name), P(), P(), P(), P()),
-        axis_names={axis_name},
+        # Full-manual over every mesh axis: the partial-manual mode
+        # (axis_names={axis_name}, dp left auto) dies in XLA's SPMD
+        # partitioner on this jax/XLA pair — an unannotated
+        # partition-id HLO at best, a manual-subgroup CHECK crash at
+        # worst.  Under full-manual the dp groups run identical
+        # replicated compute, which is what the auto annotations
+        # declared anyway.
         check_vma=False,
     )
     blocks = decomp.block_params(p)
     blocks_il = jax.tree.map(lambda a: jnp.take(a, perm, axis=0), blocks)
     g_blk_il, g_light, dx_out, ce, aux = pp_fn(
+        jnp.arange(pp, dtype=jnp.int32),
         blocks_il, p_light, x_mb, tok_mb, seg_mb
     )
     g_blk = jax.tree.map(lambda a: jnp.take(a, inv, axis=0), g_blk_il)

@@ -67,10 +67,13 @@ def _merge(o, lse, o_i, lse_i):
 
 def _ring_fwd_loop(
     qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
-    bias=None, heads=None, segs=None,
+    bias=None, heads=None, segs=None, idx1=None,
 ):
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    # ``idx1`` is the wrapper-fed [1] ring position (see
+    # wrap_seq_parallel_attn's index_axis); axis_index stays as the
+    # fallback for direct in-shard_map callers.
+    idx = idx1[0] if idx1 is not None else lax.axis_index(axis_name)
     BH, s, D = qh.shape
     t = kh.shape[1]
 
@@ -126,39 +129,40 @@ def _ring_fwd_loop(
     return o.astype(qh.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
-def _ring_flash(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _ring_flash(qh, kh, vh, bias, qseg, kseg, idx1, groups, heads, causal,
                 axis_name, bq, bk, interpret):
     """One differentiable ring for every call shape: ``bias`` is either a
     row-sharded [Hb, s, T_total] array or ``None`` (an empty pytree —
     its cotangent is ``None`` and the dbias strips are skipped);
     ``qseg``/``kseg`` are [B, s] local / [B, T_total] resident segment
-    ids or ``None`` (integer operands, zero cotangent)."""
+    ids or ``None`` (integer operands, zero cotangent); ``idx1`` is the
+    optional [1] ring position (integer operand, zero cotangent)."""
     out, _ = _ring_fwd_loop(
         qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
         bias=bias, heads=heads,
-        segs=None if qseg is None else (qseg, kseg),
+        segs=None if qseg is None else (qseg, kseg), idx1=idx1,
     )
     return out
 
 
-def _ring_flash_fwd(qh, kh, vh, bias, qseg, kseg, groups, heads, causal,
+def _ring_flash_fwd(qh, kh, vh, bias, qseg, kseg, idx1, groups, heads, causal,
                     axis_name, bq, bk, interpret):
     out, lse = _ring_fwd_loop(
         qh, kh, vh, groups, causal, axis_name, bq, bk, interpret,
         bias=bias, heads=heads,
-        segs=None if qseg is None else (qseg, kseg),
+        segs=None if qseg is None else (qseg, kseg), idx1=idx1,
     )
-    return out, (qh, kh, vh, bias, qseg, kseg, out, lse)
+    return out, (qh, kh, vh, bias, qseg, kseg, idx1, out, lse)
 
 
 def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
                     res, do):
-    qh, kh, vh, bias, qseg, kseg, out, lse = res
+    qh, kh, vh, bias, qseg, kseg, idx1, out, lse = res
     has_bias = bias is not None
     has_segs = qseg is not None
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    idx = idx1[0] if idx1 is not None else lax.axis_index(axis_name)
     BH, s, D = qh.shape
     BKV, t = kh.shape[0], kh.shape[1]
     # Lane-broadcast padded global lse, the row-carrier layout the
@@ -255,6 +259,7 @@ def _ring_flash_bwd(groups, heads, causal, axis_name, bq, bk, interpret,
         dbias.astype(bias.dtype) if has_bias else None,
         None,  # qseg: integer operand, zero cotangent
         None,  # kseg
+        None,  # idx1: ring position, zero cotangent
     )
 
 
@@ -273,6 +278,7 @@ def ring_flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    axis_idx: Optional[jax.Array] = None,  # [1] ring position (optional)
 ) -> jax.Array:
     """Flash-kernel ring attention; call inside ``shard_map``.
 
@@ -335,8 +341,8 @@ def ring_flash_attention(
                 f"kv_seg [B, T_total]=[{B}, {n * t}] resident), got "
                 f"{tuple(qseg.shape)} / {tuple(kseg.shape)}."
             )
-    out = _ring_flash(qh, kh, vh, bias, qseg, kseg, groups, H, causal,
-                      axis_name, bq, bk, interpret)
+    out = _ring_flash(qh, kh, vh, bias, qseg, kseg, axis_idx, groups, H,
+                      causal, axis_name, bq, bk, interpret)
     return out.reshape(B, H, s, D).transpose(0, 2, 1, 3)
 
 
@@ -368,7 +374,7 @@ def make_ring_flash_attention(
     b = tuple(a for a in batch_axes if a in present) or None
     h = tuple(a for a in head_axes if a in present) or None
 
-    def per_device(q, k, v, causal, bias, segs):
+    def per_device(q, k, v, causal, bias, segs, idx=None):
         if causal and q.shape[1] != k.shape[1]:
             # Causal cross-attention: the dense ring handles the
             # bottom-right offset the flash path does not.
@@ -379,6 +385,7 @@ def make_ring_flash_attention(
         return ring_flash_attention(
             q, k, v, axis_name=seq_axis, causal=causal, bias=bias,
             segment_ids=segs, block_q=block_q, block_k=block_k,
+            axis_idx=idx,
         )
 
     return wrap_seq_parallel_attn(
@@ -391,4 +398,5 @@ def make_ring_flash_attention(
         # (q_seg, kv_seg): query ids row-sharded, key ids fully resident.
         seg_specs=(P(b, seq_axis), P(b, None)),
         per_device=per_device,
+        index_axis=seq_axis,
     )

@@ -62,6 +62,7 @@ def make_train_step(
     pipeline: bool = False,
     pipeline_axis: str = "pp",
     pipeline_schedule: str = "gpipe",
+    pipeline_executor: Optional[str] = None,
     n_microbatches: int = 4,
     n_chunks: int = 2,
     attn_fn=None,
@@ -80,6 +81,12 @@ def make_train_step(
     * ``"1f1b"`` — fused forward+backward one-forward-one-backward
       schedule (:func:`~torchdistx_tpu.parallel.pipeline.pipeline_train_1f1b`):
       bounded in-flight state via stage-input stash + recompute.
+
+    ``pipeline_executor`` selects the fused schedules' loop structure
+    (``"segmented"`` phase-specialized default / ``"uniform"`` parity
+    baseline — docs/performance.md §The schedule executor); ``None``
+    follows ``TDX_PP_EXECUTOR``.  Both are bitwise-equal; the knob
+    exists for the bench A/B and parity tests.
     """
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     baxes = tuple(a for a in batch_axes if a in mesh.axis_names)
@@ -121,6 +128,18 @@ def make_train_step(
             logits, aux_vars = model.apply(params, *args, mutable=["losses"])
             return logits, _sum_aux(aux_vars.get("losses", {}))
         return model.apply(params, *args), jnp.float32(0.0)
+
+    if pipeline and cfg.moe is not None:
+        # jax 0.4.x shard_map partial-eval keeps a forwarded SCALAR
+        # residual (the MoE router aux) at its {0: mesh_axes} spec
+        # without the singleton-promotion reshape, so grad-of-shard_map
+        # dies in _check_names (_SpecError on a float32[] aval).
+        # Rematerializing the pipelined forward turns every residual
+        # into a forwarded *input* — no scalar residuals survive — at
+        # the cost of a second forward pass on the GPipe+MoE grad path
+        # only (the fused 1F1B schedules build their own backward and
+        # never hit this).
+        forward = jax.checkpoint(forward)
 
     def loss_fn(params, tokens, segment_ids=None):
         logits, aux = forward(params, tokens, segment_ids)
@@ -183,7 +202,7 @@ def make_train_step(
                 cfg, state["params"], tokens, mesh, decomp=decomp,
                 n_microbatches=n_microbatches, axis_name=pipeline_axis,
                 attn_fn=attn_fn or default_attention,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, executor=pipeline_executor,
             )
             loss, ce, aux = metrics["loss"], metrics["ce"], metrics["aux"]
         else:
