@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke soak-smoke bench-smoke lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -52,6 +52,7 @@ chaos-test: registry-smoke serve-smoke obs-smoke
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py \
 	    tests/test_flightrec.py tests/test_materialize_transport.py \
+	    tests/test_live_ops.py tests/test_bench_trend.py \
 	    -q -p no:cacheprovider
 
 # Observability smoke (docs/observability.md §Flight recorder): an
@@ -137,6 +138,13 @@ bench-smoke:
 	              'parity_bitwise', s['parity_bitwise'], \
 	              'measured_vs_analytic', mva, \
 	              'seg_vs_uniform', s.get('segmented_vs_uniform'))"
+
+# Bench-trajectory regression sentinel (docs/observability.md): render
+# the per-headline-key trend across every BENCH_r*.json round and exit
+# 1 if a gated key regressed vs its best comparable (same hardware
+# class) prior round.
+bench-trend:
+	python tools/bench_trend.py
 
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,

@@ -1801,13 +1801,22 @@ def _run_phase_inner(name: str, timeout: float, cache_fallback: bool, _sp):
     from torchdistx_tpu._probe import run_in_killable_group
 
     argv = [sys.executable, os.path.abspath(__file__), "--phase", name]
+    # Causal handoff: a flow-start inside this bench.phase span plus a
+    # TDX_TRACE_PARENT env token makes the merged Chrome trace draw an
+    # arrow from this span to the subprocess's first span.
+    if observe.enabled():
+        from torchdistx_tpu.observe import tracectx
+
+        child_env = tracectx.child_env(tracectx.flow_start("bench.spawn"))
+    else:
+        child_env = None
     out_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
                                    errors="replace")
     err_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8",
                                    errors="replace")
     try:
         rc = run_in_killable_group(argv, timeout, stdout=out_f,
-                                   stderr=err_f, cwd=REPO)
+                                   stderr=err_f, cwd=REPO, env=child_env)
         if rc is None:
             err = {"error": f"phase {name} timed out after {timeout:.0f}s",
                    "timeout_s": timeout}

@@ -44,30 +44,96 @@ else:
     raise SystemExit("expected MaterializationError")
 EOF
 
-echo "== 3. chaos serve fault mid-batch leaves a dump, outputs stay oracle-equal =="
+echo "== 3. chaos serve fault: dump + oracle-equal outputs + LIVE endpoint scrapes =="
 TDX_FLIGHT_DIR="$FLIGHT" TDX_FAULT_PLAN='serve@2=raise' \
 TDX_METRICS_EXPORT_S=0.2 TDX_METRICS_PATH="$TMP/flight/%h/metrics.prom" \
+TDX_OBS_PORT=0 TDX_OBS_PORT_FILE="$TMP/obs.port" \
 TDX_CACHE_DIR="$TMP/serve_cache" python - <<'EOF'
+import json
+import threading
 import time
+import urllib.error
+import urllib.request
+
+from torchdistx_tpu import observe
 from torchdistx_tpu.serve import (
     Request, ServeConfig, oracle_generate, spin_up_replica,
 )
 
+
+def get(path, timeout=10.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+observe.counter("tdx.smoke.arm").inc()  # first emission arms the httpd
+srv = observe.httpd.get_server()
+assert srv is not None, "TDX_OBS_PORT=0 set but no server armed"
+base = srv.url()
+with open(srv.port_file) as f:  # the launcher-facing port file
+    assert int(f.read()) == srv.port
+
+# Poll /readyz while the replica brings up: the probe must be 503 during
+# spin_up/warming and flip to 200 only once the program set is ready.
+ready_codes, stop = [], threading.Event()
+
+
+def poll():
+    while not stop.is_set():
+        ready_codes.append(get("/readyz")[0])
+        time.sleep(0.02)
+
+
+t = threading.Thread(target=poll, daemon=True)
+t.start()
 scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
                    max_pages_per_seq=4, prefill_buckets=(8,))
 eng = spin_up_replica("tiny", serve_cfg=scfg)
+stop.set()
+t.join(timeout=5)
+assert 503 in ready_codes, f"never saw a not-ready probe: {set(ready_codes)}"
+assert get("/readyz")[0] == 200, "replica serving but /readyz still false"
+print(f"  /readyz flipped 503 -> 200 across bring-up "
+      f"({ready_codes.count(503)} not-ready polls)")
+
+
+def chaos_total():
+    text = get("/metrics")[1].decode()
+    return sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("tdx_chaos_injected")
+    )
+
+
+before = chaos_total()
 reqs = [Request(f"r{i}", [3 + i, 7, 11], max_new_tokens=4) for i in range(3)]
 out = eng.run(reqs)
 for r in reqs:
     want, _ = oracle_generate(eng.family, eng.cfg, eng.params,
                               r.tokens, r.max_new_tokens)
     assert out[r.rid] == want, (r.rid, out[r.rid], want)
+after = chaos_total()
+assert after > before, f"chaos counter never moved live ({before} -> {after})"
+print(f"  /metrics saw the chaos fault live: tdx_chaos_injected "
+      f"{before:g} -> {after:g}")
+
+status, body = get("/healthz")
+assert status == 200, body
+status, body = get("/slo")
+assert status == 200, body
+live = json.loads(body)["slo"]["serve"]
+assert "ttft" in live and "token" in live, live
 slo = eng.slo.snapshot()
 assert "ttft" in slo and "token" in slo, slo
 time.sleep(0.5)  # let the periodic exporter fire at least once
-print(f"  {len(reqs)} requests == oracle through the fault; "
-      f"SLO p50 TTFT {slo['ttft']['p50']*1e3:.1f}ms")
+print(f"  {len(reqs)} requests == oracle through the fault; live /slo "
+      f"p50 TTFT {live['ttft']['p50']*1e3:.1f}ms")
 EOF
+test ! -e "$TMP/obs.port"  # clean shutdown removed the port file
 
 echo "== 4. uncaught exception -> excepthook dump =="
 set +e
@@ -107,5 +173,63 @@ grep -q "serve_fault" "$TMP/fleet.txt"
 test -s "$HOSTDIR/metrics.prom"
 grep -q "tdx_serve_slo_ttft_p50_s" "$HOSTDIR/metrics.prom"
 sed -n '1,12p' "$TMP/fleet.txt" | sed 's/^/  /'
+
+echo "== 6. 2-shard spawned warm: merged Chrome trace draws the spawn arrows =="
+TDX_TRACE_DIR="$TMP/warm_traces" python tools/warm_cache.py --model demo \
+    --cache-dir "$TMP/warm_cache" --registry-dir "$TMP/warm_registry" \
+    --hosts 2 --spawn-shards
+python tools/tdx_trace.py chrome "$TMP/warm_traces" -o "$TMP/warm.json"
+python - "$TMP/warm.json" <<'EOF'
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+ev = doc["traceEvents"]
+spans = [e for e in ev if e.get("ph") == "X"]
+pids = {e["pid"] for e in spans}
+assert len(pids) >= 3, f"want parent + 2 shard pids, got {pids}"
+spawn = next(e for e in spans if e["name"] == "warm.spawn")
+starts = [e for e in ev if e.get("ph") == "s"]
+finishes = {e["id"]: e for e in ev if e.get("ph") == "f"}
+links = [(s, finishes[s["id"]]) for s in starts if s["id"] in finishes]
+assert len(links) >= 2, f"want a flow link per shard, got {len(links)}"
+shard_pids = set()
+for s, f in links:
+    assert s["pid"] == spawn["pid"], "arrow tail must be the spawn span"
+    # the tail sits inside the parent's warm.spawn slice...
+    assert spawn["ts"] <= s["ts"] <= spawn["ts"] + spawn["dur"]
+    assert f["pid"] != spawn["pid"], "arrow head must land in a shard"
+    # ...and the head inside one of the shard's own spans.
+    assert any(e["pid"] == f["pid"]
+               and e["ts"] <= f["ts"] <= e["ts"] + e["dur"]
+               for e in spans), "flow finish not inside a shard span"
+    shard_pids.add(f["pid"])
+assert len(shard_pids) == 2, f"arrows reached {len(shard_pids)} shard(s)"
+labels = {e["args"]["labels"] for e in ev if e.get("name") == "process_labels"}
+assert len(labels) == 1, f"one causal trace id expected, got {labels}"
+assert "tdxUnpairedFlowEventsDropped" not in doc
+print(f"  {len(links)} spawn arrows parent pid {spawn['pid']} -> shards "
+      f"{sorted(shard_pids)}, one trace id across {len(pids)} processes")
+EOF
+
+echo "== 7. bench-trend sentinel: real history clean, synthetic regression exits 1 =="
+python tools/bench_trend.py > "$TMP/trend.txt"
+grep -q "no regressions" "$TMP/trend.txt"
+mkdir -p "$TMP/trend"
+cat > "$TMP/trend/BENCH_r01.json" <<'EOF'
+{"n": 1, "rc": 0, "parsed": {"platform": "cpu", "host_cpu_count": 8,
+ "vs_baseline": 1.05, "value": 3.3}}
+EOF
+cat > "$TMP/trend/BENCH_r02.json" <<'EOF'
+{"n": 2, "rc": 0, "parsed": {"platform": "cpu", "host_cpu_count": 8,
+ "vs_baseline": 0.5, "value": 3.4}}
+EOF
+set +e
+python tools/bench_trend.py "$TMP"/trend/BENCH_r*.json > "$TMP/trend_bad.txt"
+rc=$?
+set -e
+test "$rc" -eq 1  # the CI contract: a gated regression exits 1
+grep -q "REGRESSIONS: 1" "$TMP/trend_bad.txt"
+grep -q "r02 vs_baseline" "$TMP/trend_bad.txt"
+echo "  $(grep -c . "$TMP/trend.txt") trend lines clean; synthetic vs_baseline halving tripped rc=1"
 
 echo "obs-smoke OK"

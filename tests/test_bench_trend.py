@@ -1,0 +1,178 @@
+"""Bench-trajectory sentinel (tools/bench_trend.py): the trend table
+renders the repo's real BENCH_r*.json history without error, regressions
+in gated relative/efficiency keys exit 1 against the best COMPARABLE
+prior round, absolute wall times never gate (the recorded history
+proves they measure the host, not the code), unknown hardware classes
+neither gate nor baseline, and truncated rounds are tolerated."""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bt():
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(REPO, "tools", "bench_trend.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _round(tmp_path, n, parsed):
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps({"n": n, "rc": 0, "parsed": parsed}))
+    return str(p)
+
+
+_CPU = {"platform": "cpu(fallback: tpu unavailable)", "host_cpu_count": 8}
+
+
+class TestUnits:
+    def test_hw_class_takes_platform_first_token(self, bt):
+        assert bt.hw_class({"platform": "cpu(fallback: x)"}) == "cpu"
+        assert bt.hw_class({"platform": "tpu (cached v5e)"}) == "tpu"
+        assert bt.hw_class({"platform": "CPU"}) == "cpu"
+        assert bt.hw_class({}) is None
+        assert bt.hw_class({"platform": "   "}) is None
+        assert bt.hw_class({"platform": 7}) is None
+
+    def test_comparable_needs_same_class_and_cpu_count(self, bt):
+        a = {"platform": "cpu", "host_cpu_count": 8}
+        assert bt.comparable(a, {"platform": "cpu(fallback)",
+                                 "host_cpu_count": 8})
+        assert not bt.comparable(a, {"platform": "tpu", "host_cpu_count": 8})
+        assert not bt.comparable(a, {"platform": "cpu", "host_cpu_count": 4})
+        # rounds before the cpu-count stamp compare by platform alone
+        assert bt.comparable(a, {"platform": "cpu"})
+        assert not bt.comparable(a, {})
+
+    def test_gated_keys_are_relative_not_absolute(self, bt):
+        assert bt.gate_for("vs_baseline") == ("up", 0.10)
+        assert bt.gate_for("decode_vs_baseline") == ("up", 0.20)
+        assert bt.gate_for("materialize_gbps") is not None
+        assert bt.gate_for("pipeline_speedup") is not None
+        assert bt.gate_for("train_mfu") is not None
+        assert bt.gate_for("peak_rss_mb") == ("down", 0.15)
+        # absolute seconds and counts never gate
+        assert bt.gate_for("value") is None
+        assert bt.gate_for("baseline_s") is None
+        assert bt.gate_for("materialize_s") is None
+        assert bt.gate_for("n_programs") is None
+
+
+class TestRealHistory:
+    def test_repo_rounds_render_clean(self, bt, capsys):
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        if not paths:
+            pytest.skip("no BENCH rounds in this checkout")
+        rc = bt.main(paths)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "no regressions" in out
+        assert f"{len(paths)} round(s)" in out
+
+
+class TestRegressionGate:
+    def test_regressed_ratio_exits_1(self, bt, tmp_path, capsys):
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "vs_baseline": 1.05, "value": 3.3}),
+            _round(tmp_path, 2, {**_CPU, "vs_baseline": 0.5, "value": 3.4}),
+        ]
+        rc = bt.main(paths)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSIONS: 1" in out
+        assert "r02 vs_baseline" in out
+        assert "0.5!" in out  # flagged cell in the table too
+
+    def test_doubled_wall_time_does_not_gate(self, bt, tmp_path, capsys):
+        # The real r02→r03 shape: host slowdown doubles `value` while
+        # the same-host ratio barely moves — must NOT flag.
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "vs_baseline": 1.07, "value": 3.3}),
+            _round(tmp_path, 2, {**_CPU, "vs_baseline": 1.04, "value": 6.7}),
+        ]
+        assert bt.main(paths) == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_unknown_platform_never_gates_or_baselines(self, bt, tmp_path,
+                                                       capsys):
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "vs_baseline": 2.0}),
+            _round(tmp_path, 2, {"vs_baseline": 0.1}),          # no platform
+            _round(tmp_path, 3, {**_CPU, "vs_baseline": 1.9}),
+        ]
+        assert bt.main(paths) == 0
+        capsys.readouterr()
+
+    def test_cross_class_rounds_do_not_compare(self, bt, tmp_path, capsys):
+        paths = [
+            _round(tmp_path, 1, {"platform": "tpu v5e", "vs_baseline": 3.0}),
+            _round(tmp_path, 2, {**_CPU, "vs_baseline": 1.0}),
+        ]
+        assert bt.main(paths) == 0
+        capsys.readouterr()
+
+    def test_best_prior_not_latest_is_the_baseline(self, bt, tmp_path,
+                                                   capsys):
+        # A slow slide: each round is within threshold of the LAST one
+        # but far below the BEST one — the gate must catch it.
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "vs_baseline": 1.00}),
+            _round(tmp_path, 2, {**_CPU, "vs_baseline": 0.95}),
+            _round(tmp_path, 3, {**_CPU, "vs_baseline": 0.88}),
+        ]
+        rc = bt.main(paths)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "r03 vs_baseline" in out and "r01" in out
+
+    def test_down_direction_gates_rss_growth(self, bt, tmp_path, capsys):
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "peak_rss_mb": 900.0}),
+            _round(tmp_path, 2, {**_CPU, "peak_rss_mb": 1200.0}),
+        ]
+        rc = bt.main(paths)
+        assert rc == 1
+        assert "r02 peak_rss_mb" in capsys.readouterr().out
+
+
+class TestResilience:
+    def test_empty_parsed_round_tolerated(self, bt, tmp_path, capsys):
+        paths = [
+            _round(tmp_path, 1, {**_CPU, "vs_baseline": 1.0}),
+            _round(tmp_path, 4, None),  # truncated tail → parsed absent
+        ]
+        assert bt.main(paths) == 0
+        out = capsys.readouterr().out
+        assert "r04" in out and "truncated/failed round" in out
+
+    def test_unreadable_file_skipped_with_warning(self, bt, tmp_path,
+                                                  capsys):
+        good = _round(tmp_path, 1, {**_CPU, "vs_baseline": 1.0})
+        bad = tmp_path / "BENCH_r02.json"
+        bad.write_text("{truncated")
+        assert bt.main([good, str(bad)]) == 0
+        assert "skipping" in capsys.readouterr().err
+
+    def test_no_rounds_exits_2(self, bt, tmp_path, capsys):
+        assert bt.main([str(tmp_path / "nothing.json")]) == 2
+        capsys.readouterr()
+
+    def test_bookkeeping_keys_never_render(self, bt, tmp_path, capsys):
+        paths = [_round(tmp_path, 1, {
+            **_CPU, "vs_baseline": 1.0, "rc": 0, "n": 3,
+            "record_skipped": 1, "cache_age_s": 9.9,
+        })]
+        assert bt.main(paths) == 0
+        out = capsys.readouterr().out
+        assert "record_skipped" not in out and "cache_age_s" not in out

@@ -1,0 +1,216 @@
+"""Docs-vs-code metric lint: every ``tdx.*`` metric the code emits must
+appear in docs/observability.md's vocabulary table, and every name the
+table documents must still be emitted somewhere — the table can neither
+rot behind the code nor advertise metrics that no longer exist.
+
+The scanner reads emission call sites (``counter(`` / ``gauge(`` /
+``histogram(`` plus the repo's two local aliases, ``g = ...gauge`` in
+pipeline.py and ``StepMeter._gauge``); f-string emission sites must
+register their concrete expansions in ``TEMPLATES`` below, so adding a
+new templated metric forces this lint to learn its value set.
+
+The docs table is parsed with the table's own conventions:
+
+* backticked tokens in the Metric cell; ``{a,b,c}`` braces expand,
+  ``{label}`` braces (no comma — a label dimension) drop;
+* a token starting ``tdx.`` is an ANCHOR;
+* a bare-word token (``fetch_hit``) replaces the anchor's last dotted
+  component;
+* a ``_suffix`` token generates candidates by appending after stripping
+  0..n trailing underscore segments (``_miss`` on
+  ``tdx.jax.compile_cache_hit`` → ``tdx.jax.compile_cache_miss``;
+  ``_window_count`` on ``tdx.serve.slo.ttft_p50_s`` →
+  ``tdx.serve.slo.ttft_window_count``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+# Emission call sites: the public emitters, pipeline.py's local
+# `g = observe.counters().gauge` alias, and StepMeter's `_gauge` /
+# `_hist` methods.  `\(\s*` spans newlines, so multi-line calls
+# (engine.py's token_latency_s histogram) are caught.
+_EMIT = re.compile(
+    r"""(?:\b(?:counter|gauge|histogram|g)|_gauge|_hist)"""
+    r"""\(\s*(f?)["'](tdx\.[^"']+)["']"""
+)
+
+# Concrete expansions for every f-string emission template in the repo.
+# A NEW templated emission site fails the lint until its value set is
+# registered here — that is the point.
+_SLO_NAMES = ("ttft", "token", "queue_wait")
+TEMPLATES: Dict[str, Tuple[str, ...]] = {
+    "tdx.jax.compile_cache_{outcome}": tuple(
+        f"tdx.jax.compile_cache_{o}"
+        for o in ("hit", "miss", "uncached", "bypass")
+    ),
+    "tdx.jax.compiler_option_{outcome}": tuple(
+        f"tdx.jax.compiler_option_{o}" for o in ("accepted", "rejected")
+    ),
+    "tdx.serve.slo.{name}_p{q}_s": tuple(
+        f"tdx.serve.slo.{n}_p{q}_s"
+        for n in _SLO_NAMES for q in (50, 95, 99)
+    ),
+    "tdx.serve.slo.{name}_window_count": tuple(
+        f"tdx.serve.slo.{n}_window_count" for n in _SLO_NAMES
+    ),
+    "tdx.train.{key}": tuple(
+        f"tdx.train.{k}"
+        for k in ("tokens_per_s", "tflops", "mfu", "mfu_est")
+    ),
+    "tdx.pp.segment_{s.role}_ms": tuple(
+        f"tdx.pp.segment_{r}_ms" for r in ("warmup", "steady", "cooldown")
+    ),
+}
+
+
+def emitted_metrics() -> Dict[str, List[str]]:
+    """{concrete metric name: [files emitting it]} across the package
+    and bench.py, with f-string templates expanded via TEMPLATES."""
+    files = sorted(glob.glob(
+        os.path.join(REPO, "torchdistx_tpu", "**", "*.py"), recursive=True,
+    )) + [os.path.join(REPO, "bench.py")]
+    out: Dict[str, List[str]] = {}
+    for fn in files:
+        with open(fn) as f:
+            src = f.read()
+        rel = os.path.relpath(fn, REPO)
+        for m in _EMIT.finditer(src):
+            name = m.group(2)
+            if "{" in name:
+                assert name in TEMPLATES, (
+                    f"{rel}: f-string metric template {name!r} has no "
+                    f"registered expansion in TEMPLATES — add its value "
+                    f"set so the docs lint can check it"
+                )
+                concrete = TEMPLATES[name]
+            else:
+                concrete = (name,)
+            for c in concrete:
+                out.setdefault(c, []).append(rel)
+    return out
+
+
+# -- docs-table parsing ------------------------------------------------------
+
+
+def _expand_braces(token: str) -> List[str]:
+    """``{a,b,c}`` → one variant per option; ``{label}`` (no comma) is a
+    label dimension and drops from the name."""
+    m = re.search(r"\{([^{}]*)\}", token)
+    if not m:
+        return [token]
+    head, tail = token[:m.start()], token[m.end():]
+    inner = m.group(1)
+    options = inner.split(",") if "," in inner else [""]
+    out: List[str] = []
+    for opt in options:
+        out.extend(_expand_braces(head + opt + tail))
+    return out
+
+
+def _suffix_candidates(anchor: str, suffix: str) -> List[str]:
+    """Append ``suffix`` after stripping 0..n trailing underscore
+    segments of the anchor (the table's `` `name_a` / `_b` ``
+    shorthand)."""
+    segs = anchor.split("_")
+    return [
+        "_".join(segs[: len(segs) - j]) + suffix
+        for j in range(len(segs))
+        if "_".join(segs[: len(segs) - j])
+    ]
+
+
+def docs_rows() -> List[Tuple[str, List[Set[str]]]]:
+    """Per table row: (raw metric cell, [candidate-name set per token]).
+
+    A token's candidate set is every concrete metric name that token
+    could denote; the row is parsed left to right so bare-word and
+    suffix tokens resolve against the latest ``tdx.`` anchor.
+    """
+    with open(DOCS) as f:
+        lines = f.read().splitlines()
+    rows: List[Tuple[str, List[Set[str]]]] = []
+    in_table = False
+    for line in lines:
+        if re.match(r"\|\s*Metric\s*\|", line):
+            in_table = True
+            continue
+        if in_table and not line.startswith("|"):
+            in_table = False
+            continue
+        if not in_table:
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 3 or cells[1] not in ("C", "G", "H"):
+            continue  # separator / malformed
+        tokens = re.findall(r"`([^`]+)`", cells[0])
+        anchors: List[str] = []
+        per_token: List[Set[str]] = []
+        for tok in tokens:
+            variants = [v for v in _expand_braces(tok) if v]
+            if not variants:
+                continue  # pure label token, e.g. `{schedule}`
+            if variants[0].startswith("tdx."):
+                anchors = variants
+                per_token.append(set(variants))
+            elif variants[0].startswith("_"):
+                assert anchors, f"suffix token {tok!r} before any anchor"
+                per_token.append({
+                    c for a in anchors for v in variants
+                    for c in _suffix_candidates(a, v)
+                })
+            else:
+                assert anchors, f"bare token {tok!r} before any anchor"
+                prefix = anchors[0].rsplit(".", 1)[0]
+                per_token.append({f"{prefix}.{v}" for v in variants})
+        if per_token:
+            rows.append((cells[0], per_token))
+    return rows
+
+
+def test_docs_table_parses():
+    rows = docs_rows()
+    assert len(rows) >= 25, f"only {len(rows)} metric rows parsed"
+    names = {c for _cell, toks in rows for s in toks for c in s}
+    # Spot-check the expansion rules on their trickiest customers.
+    assert "tdx.jax.compile_cache_miss" in names          # `_miss` suffix
+    assert "tdx.serve.slo.ttft_window_count" in names     # 2-segment strip
+    assert "tdx.pp.segment_cooldown_ms" in names          # comma braces
+    assert "tdx.registry.fetch_hit" in names              # bare word
+    assert "tdx.observe.http_requests" in names           # label brace
+
+
+def test_every_emitted_metric_is_documented():
+    documented = {
+        c for _cell, toks in docs_rows() for s in toks for c in s
+    }
+    missing = {
+        name: files for name, files in emitted_metrics().items()
+        if name not in documented
+    }
+    assert not missing, (
+        "metrics emitted but absent from docs/observability.md's "
+        f"vocabulary table: {missing}"
+    )
+
+
+def test_no_stale_docs_table_names():
+    emitted = set(emitted_metrics())
+    stale = [
+        (cell, sorted(candidates)[:4])
+        for cell, toks in docs_rows()
+        for candidates in toks
+        if not candidates & emitted
+    ]
+    assert not stale, (
+        "docs/observability.md documents metrics nothing emits "
+        f"(row cell, unmatched candidates): {stale}"
+    )

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression sentinel over the per-round BENCH files.
+
+``bench.py`` leaves one ``BENCH_rNN.json`` per round (the driver's
+record: ``{n, cmd, rc, tail, parsed}``).  Each file is a point; the
+TRAJECTORY is the signal — a headline that quietly decayed two rounds
+ago is invisible in any single file.  This tool (stdlib only, like
+``tdx_trace.py``):
+
+* loads every ``BENCH_r*.json`` in the repo root (or the paths given),
+* renders a per-key trend table across rounds — every numeric parsed
+  key, rounds as columns, so the whole history reads at a glance,
+* flags regressions: for each GATED key, a round is compared against
+  the best COMPARABLE prior round and flagged when it is worse by more
+  than the key's threshold,
+* exits 1 when any regression is flagged (the CI contract;
+  ``make bench-trend``), 2 when no bench files were found.
+
+**Comparable** means the same hardware class: the platform's first
+token (``cpu(fallback: ...)`` → ``cpu``, ``tpu (cached ...)`` →
+``tpu``) plus ``host_cpu_count`` when both rounds stamp it (rounds
+before the stamp existed compare by platform alone).  A round with an
+unknown platform (or an empty ``parsed`` — truncated tails happen; see
+r04) renders in the table but neither gates nor serves as a baseline.
+
+**Gated keys are the relative/efficiency headlines, not absolute
+seconds.**  The recorded history proves why: round 3's wall times are
+~2x round 2's on the same class (``value`` 3.3 s → 6.7 s) because the
+shared CI host itself slowed down (``baseline_s`` moved identically),
+while ``vs_baseline`` — ours measured against the baseline on the SAME
+host in the SAME round — barely moved (1.07 → 1.04).  Absolute timings
+measure the host that day; ratios, bandwidths, MFU, and RSS measure the
+code.  Those gate; raw ``*_s`` timings render ungated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Gated keys: (regex on the key) -> (direction, threshold).  Direction
+# "up" = higher is better (regression when current < best * (1 - thr)),
+# "down" = lower is better (regression when current > best * (1 + thr)).
+# Thresholds are per-key because noise floors differ: same-host ratios
+# are tight, RSS wobbles with allocator mood, flash speedups swing with
+# clock throttling.
+GATES: List[Tuple[str, str, float]] = [
+    (r"^vs_baseline$", "up", 0.10),
+    (r"_vs_baseline$", "up", 0.20),
+    (r"(^|_)materialize_gbps$", "up", 0.20),
+    (r"_speedup$", "up", 0.15),
+    (r"_mfu$", "up", 0.15),
+    (r"_rss_mb$", "down", 0.15),
+]
+
+# Keys that are bookkeeping, not measurements — never worth a table row.
+_SKIP_KEYS = re.compile(
+    r"(_skipped|_stale_s|_age_s|_from_cache|^rc$|^n$)"
+)
+
+
+def gate_for(key: str) -> Optional[Tuple[str, float]]:
+    for pat, direction, thr in GATES:
+        if re.search(pat, key):
+            return direction, thr
+    return None
+
+
+def hw_class(parsed: dict) -> Optional[str]:
+    """Hardware-class token for comparability, None when unknown."""
+    platform = parsed.get("platform")
+    if not isinstance(platform, str) or not platform.strip():
+        return None
+    return re.split(r"[\s(]", platform.strip(), 1)[0].lower() or None
+
+
+def comparable(a: dict, b: dict) -> bool:
+    ca, cb = hw_class(a), hw_class(b)
+    if ca is None or cb is None or ca != cb:
+        return False
+    na, nb = a.get("host_cpu_count"), b.get("host_cpu_count")
+    if na is not None and nb is not None and na != nb:
+        return False
+    return True
+
+
+def load_rounds(paths: List[str]) -> List[Tuple[int, str, dict]]:
+    """[(round_no, path, parsed_dict)] sorted by round number."""
+    rounds = []
+    for path in paths:
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        parsed = doc.get("parsed")
+        rounds.append((int(m.group(1)), path,
+                       parsed if isinstance(parsed, dict) else {}))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def _numeric(parsed: dict) -> Dict[str, float]:
+    return {
+        k: float(v) for k, v in parsed.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and not _SKIP_KEYS.search(k)
+    }
+
+
+def find_regressions(
+    rounds: List[Tuple[int, str, dict]],
+) -> List[dict]:
+    """Every (round, key) flagged against its best comparable prior."""
+    out = []
+    for i, (rno, _path, parsed) in enumerate(rounds):
+        if hw_class(parsed) is None:
+            continue  # unknown hardware cannot gate
+        nums = _numeric(parsed)
+        for key, value in nums.items():
+            gate = gate_for(key)
+            if gate is None:
+                continue
+            direction, thr = gate
+            prior = [
+                (pno, pparsed[key]) for pno, _pp, pparsed in rounds[:i]
+                if comparable(parsed, pparsed)
+                and isinstance(pparsed.get(key), (int, float))
+                and not isinstance(pparsed.get(key), bool)
+            ]
+            if not prior:
+                continue
+            if direction == "up":
+                best_no, best = max(prior, key=lambda p: p[1])
+                bad = value < best * (1.0 - thr)
+            else:
+                best_no, best = min(prior, key=lambda p: p[1])
+                bad = value > best * (1.0 + thr)
+            if bad:
+                out.append({
+                    "round": rno, "key": key, "value": value,
+                    "best_round": best_no, "best": best,
+                    "direction": direction, "threshold": thr,
+                })
+    return out
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def render_table(
+    rounds: List[Tuple[int, str, dict]], regressions: List[dict],
+) -> str:
+    flagged = {(r["round"], r["key"]) for r in regressions}
+    cols = [rno for rno, _p, _d in rounds]
+    keys: List[str] = []
+    for _rno, _p, parsed in rounds:
+        for k in _numeric(parsed):
+            if k not in keys:
+                keys.append(k)
+    # Headlines first, everything else alphabetical below them.
+    head = [k for k in ("value", "vs_baseline") if k in keys]
+    keys = head + sorted(k for k in keys if k not in head)
+    lines = []
+    meta = next(
+        (d.get("metric") for _r, _p, d in reversed(rounds) if d.get("metric")),
+        None,
+    )
+    if meta:
+        lines.append(f"headline metric: {meta}")
+    lines.append(
+        "hardware class per round: " + "  ".join(
+            f"r{rno:02d}={hw_class(parsed) or '?'}"
+            for rno, _p, parsed in rounds
+        )
+    )
+    lines.append("")
+    width = max([len(k) for k in keys] or [4])
+    header = f"  {'key':<{width}}" + "".join(f" {f'r{c:02d}':>11}" for c in cols)
+    lines.append(header)
+    gated_any = False
+    for key in keys:
+        cells = []
+        for rno, _p, parsed in rounds:
+            v = _numeric(parsed).get(key)
+            cell = _fmt(v)
+            if (rno, key) in flagged:
+                cell += "!"
+            cells.append(f" {cell:>11}")
+        mark = " *" if gate_for(key) else ""
+        gated_any = gated_any or bool(mark)
+        lines.append(f"  {key:<{width}}" + "".join(cells) + mark)
+    if gated_any:
+        lines.append("")
+        lines.append("  * gated key (relative/efficiency headline); "
+                     "! regression vs best comparable prior round")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_trend", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="BENCH_r*.json files (default: the repo root's)")
+    args = ap.parse_args(argv)
+    paths = args.paths or sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    rounds = load_rounds(paths)
+    if not rounds:
+        print("no BENCH_r*.json rounds found", file=sys.stderr)
+        return 2
+    regressions = find_regressions(rounds)
+    print(f"bench trend: {len(rounds)} round(s) "
+          f"(r{rounds[0][0]:02d}..r{rounds[-1][0]:02d})")
+    empties = [rno for rno, _p, parsed in rounds if not _numeric(parsed)]
+    if empties:
+        print("note: no parsed numbers for " +
+              ", ".join(f"r{rno:02d}" for rno in empties) +
+              " (truncated/failed round) — rendered empty, never gated")
+    print(render_table(rounds, regressions))
+    if regressions:
+        print("")
+        print(f"REGRESSIONS: {len(regressions)}")
+        for r in regressions:
+            arrow = "<" if r["direction"] == "up" else ">"
+            print(
+                f"  r{r['round']:02d} {r['key']}: {_fmt(r['value'])} is "
+                f"worse than best comparable r{r['best_round']:02d} "
+                f"({_fmt(r['best'])}) by more than {r['threshold']:.0%} "
+                f"({arrow} allowed)"
+            )
+        return 1
+    print("")
+    print("no regressions vs best comparable prior rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -47,12 +47,16 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 # Mirror of torchdistx_tpu.observe.flightrec.SCHEMA_KEYS — this CLI must
 # stay importable with stdlib only (login hosts without torch/jax), so
-# it carries its own copy; keep the two in sync.
-FLIGHT_SCHEMA_VERSION = 1
+# it carries its own copy; keep the two in sync.  v2 dumps additionally
+# carry the causal identity ("trace_id" / "trace_parent"); v1 dumps stay
+# readable.
+FLIGHT_SCHEMA_VERSION = 2
+FLIGHT_SUPPORTED_SCHEMAS = (1, 2)
 FLIGHT_SCHEMA_KEYS = (
     "schema", "reason", "time", "pid", "host", "events", "config",
     "env", "counter_snapshots",
 )
+FLIGHT_SCHEMA_KEYS_V2 = ("trace_id",)
 
 
 def iter_trace_files(paths: List[str]) -> Iterator[str]:
@@ -328,8 +332,42 @@ def summarize(events: List[dict], top: int = 15) -> str:
     return "\n".join(lines)
 
 
+def pair_flows(events: List[dict]) -> Tuple[List[dict], int]:
+    """Keep only COMPLETE flow-event pairs (a ``ph:"s"`` start and at
+    least one ``ph:"f"`` finish sharing (cat, id)); returns the filtered
+    list and the dropped count.  Unpaired halves arise when a spawned
+    child never flushed (crash before its first span) or when only one
+    side's trace dir was collected — half an arrow renders as a dangling
+    artifact in Perfetto, so it is dropped and COUNTED, never silently
+    kept or silently lost."""
+    starts: set = set()
+    finishes: set = set()
+    for e in events:
+        ph = e.get("ph")
+        if ph == "s":
+            starts.add((e.get("cat"), e.get("id")))
+        elif ph == "f":
+            finishes.add((e.get("cat"), e.get("id")))
+    paired = starts & finishes
+    out: List[dict] = []
+    dropped = 0
+    for e in events:
+        if e.get("ph") in ("s", "f") \
+                and (e.get("cat"), e.get("id")) not in paired:
+            dropped += 1
+            continue
+        out.append(e)
+    return out, dropped
+
+
 def merge_chrome(events: List[dict]) -> dict:
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    events, dropped = pair_flows(events)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped:
+        # Top-level metadata: chrome://tracing ignores unknown keys, the
+        # tests and a curious operator can read the count back.
+        doc["tdxUnpairedFlowEventsDropped"] = dropped
+    return doc
 
 
 # -- flight-recorder dumps ---------------------------------------------------
@@ -355,8 +393,12 @@ def validate_flight(doc: dict) -> List[str]:
     """Stdlib mirror of observe.flightrec.validate (keep in sync)."""
     problems = [f"missing key {k!r}" for k in FLIGHT_SCHEMA_KEYS
                 if k not in doc]
-    if doc.get("schema") not in (FLIGHT_SCHEMA_VERSION,):
-        problems.append(f"unknown schema version {doc.get('schema')!r}")
+    ver = doc.get("schema")
+    if ver not in FLIGHT_SUPPORTED_SCHEMAS:
+        problems.append(f"unknown schema version {ver!r}")
+    elif isinstance(ver, int) and ver >= 2:
+        problems.extend(f"missing key {k!r}" for k in FLIGHT_SCHEMA_KEYS_V2
+                        if k not in doc)
     if not isinstance(doc.get("events"), list):
         problems.append("events is not a list")
     return problems
@@ -393,6 +435,11 @@ def render_flight(path: str, doc: dict, top: int = 8) -> str:
         f"  reason: {doc['reason']}   at {ts}   "
         f"host={doc['host']} pid={doc['pid']}"
     )
+    if doc.get("trace_id"):  # schema v2: causal identity
+        tline = f"  trace: {doc['trace_id']}"
+        if doc.get("trace_parent"):
+            tline += f"   (spawned: parent={doc['trace_parent']})"
+        lines.append(tline)
     ctx = doc.get("context") or {}
     if ctx:
         lines.append("  context: " + ", ".join(
@@ -638,6 +685,13 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
         for doc in dumps:
             r = doc.get("reason", "?")
             reasons[r] = reasons.get(r, 0) + 1
+        reg_spans: Dict[str, Dict[str, int]] = {}
+        for e in spans:
+            if e.get("name") in ("registry.publish", "registry.fetch"):
+                k = (e.get("args") or {}).get("key")
+                if k:
+                    per = reg_spans.setdefault(str(k), {})
+                    per[e["name"]] = per.get(e["name"], 0) + 1
         row = {
             "host": host,
             "spans": len(spans),
@@ -649,6 +703,7 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
             "dumps": len(dumps),
             "reasons": reasons,
             "slowest": slowest,
+            "reg_spans": reg_spans,
         }
         rows.append(row)
         for k in ("hit", "miss", "fetch", "steal", "chaos"):
@@ -689,6 +744,35 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
         lines.append("")
         lines.append("serve SLOs per host (sliding window):")
         lines.extend(slo_sections)
+    # Cross-host causal registry links: the same 12-char registry key
+    # published on one host and fetched on another IS a causal edge —
+    # host A's compile fed host B's warm.  Spans carry key=key[:12]
+    # (registry/store.py) precisely so this join works fleet-wide.
+    pub_hosts: Dict[str, List[str]] = {}
+    fetch_hosts: Dict[str, List[Tuple[str, int]]] = {}
+    for r in rows:
+        for key, per in r["reg_spans"].items():
+            if per.get("registry.publish"):
+                pub_hosts.setdefault(key, []).append(r["host"])
+            n_fetch = per.get("registry.fetch", 0)
+            if n_fetch:
+                fetch_hosts.setdefault(key, []).append((r["host"], n_fetch))
+    links = []
+    for key in sorted(fetch_hosts):
+        for pub_host in pub_hosts.get(key, []):
+            for fetch_host, n in fetch_hosts[key]:
+                if fetch_host != pub_host:
+                    links.append((key, pub_host, fetch_host, n))
+    if links:
+        lines.append("")
+        lines.append("cross-host registry links (publish → fetch by key):")
+        for key, pub_host, fetch_host, n in links[:20]:
+            times = f" ×{n}" if n > 1 else ""
+            lines.append(
+                f"  {key:<14} {pub_host} → {fetch_host}{times}"
+            )
+        if len(links) > 20:
+            lines.append(f"  ... and {len(links) - 20} more")
     slow_rows = [(r["host"], e) for r in rows for e in r["slowest"]]
     slow_rows.sort(key=lambda he: -he[1].get("dur", 0.0))
     if slow_rows:
@@ -765,7 +849,12 @@ def main(argv=None) -> int:
             with open(args.output, "w") as f:
                 json.dump(doc, f)
                 f.write("\n")
-            print(f"wrote {args.output} ({len(events)} events)")
+            note = ""
+            if doc.get("tdxUnpairedFlowEventsDropped"):
+                note = (f", {doc['tdxUnpairedFlowEventsDropped']} unpaired"
+                        " flow event(s) dropped")
+            print(f"wrote {args.output} "
+                  f"({len(doc['traceEvents'])} events{note})")
         else:
             json.dump(doc, sys.stdout)
             print()

@@ -108,6 +108,14 @@ def _parse_args(argv):
                         "(requires --registry-dir when > 1)")
     p.add_argument("--host-id", type=int, default=0,
                    help="this host's 0-based id in [0, hosts)")
+    p.add_argument("--spawn-shards", action="store_true",
+                   help="single-machine pod rehearsal: spawn all --hosts "
+                        "shard invocations as concurrent subprocesses "
+                        "(each gets its --host-id), hand each the causal "
+                        "trace context (TDX_TRACE_PARENT), and exit "
+                        "non-zero if any shard does — the merged Chrome "
+                        "trace then draws flow arrows from this parent's "
+                        "spawn span to every shard's compile spans")
     p.add_argument("--steal-after", type=float, default=120.0,
                    help="seconds to wait for another host's artifact "
                         "before compiling it locally (work stealing)")
@@ -282,8 +290,64 @@ def warm_decode(model_name, cache_dir, *, registry_dir=None, serve_cfg=None,
         )
 
 
+def _spawn_shards(args, argv) -> None:
+    """Parent mode for ``--spawn-shards``: launch every shard of the
+    sharded warm as a concurrent child of THIS process, each inheriting
+    the parent's trace context plus a per-shard flow id — so one merged
+    trace shows the whole rehearsal as a causal tree."""
+    import subprocess
+
+    from torchdistx_tpu import observe
+    from torchdistx_tpu.observe import tracectx
+
+    if args.hosts < 1:
+        raise SystemExit("--spawn-shards requires --hosts >= 1")
+    if args.hosts > 1 and not args.registry_dir:
+        raise SystemExit("--spawn-shards with --hosts > 1 requires "
+                         "--registry-dir (the shards exchange through it)")
+    # The children re-run this script with the parent's arguments minus
+    # the spawn flag and any explicit --host-id, plus their own id.
+    base = []
+    skip_next = False
+    for tok in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if tok == "--spawn-shards":
+            continue
+        if tok == "--host-id":
+            skip_next = True
+            continue
+        if tok.startswith("--host-id="):
+            continue
+        base.append(tok)
+    script = os.path.abspath(__file__)
+    procs = []
+    with observe.span(
+        "warm.spawn", category="warm", hosts=args.hosts,
+    ):
+        for host_id in range(args.hosts):
+            flow_id = (tracectx.flow_start("warm.spawn_shard")
+                       if observe.enabled() else None)
+            env = tracectx.child_env(flow_id)
+            procs.append(subprocess.Popen(
+                [sys.executable, script, *base, "--host-id", str(host_id)],
+                env=env,
+            ))
+        rcs = [p.wait() for p in procs]
+    for host_id, rc in enumerate(rcs):
+        print(f"warm: shard host_id={host_id} rc={rc}", file=sys.stderr)
+    print(json.dumps({"hosts": args.hosts, "shard_rcs": rcs}))
+    observe.flush()
+    if any(rcs):
+        raise SystemExit(1)
+
+
 def main(argv=None) -> None:
-    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    argv = list(argv if argv is not None else sys.argv[1:])
+    args = _parse_args(argv)
+    if args.spawn_shards:
+        return _spawn_shards(args, argv)
     if args.host_devices:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")

@@ -78,6 +78,7 @@ def _force(platform: str | None) -> str:
 
 def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
                           cwd: "str | None" = None,
+                          env: "dict | None" = None,
                           reap_grace: float = 10.0) -> "int | None":
     """THE hang-proof subprocess recipe, shared by every caller that has
     to survive a wedged backend (this module's probes, bench._run_phase):
@@ -99,7 +100,9 @@ def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
 
     ``stdout``/``stderr`` accept real file objects (no EOF needed to
     read back — pipes would deadlock on a helper that keeps the write
-    end open) or None for DEVNULL.  Returns the child's returncode, or
+    end open) or None for DEVNULL.  ``env`` passes through to ``Popen``
+    (None = inherit) — bench phases use it to hand the child its
+    ``TDX_TRACE_PARENT`` causal context.  Returns the child's returncode, or
     None on timeout or failed reap.  Spawn failures propagate (OSError /
     SubprocessError) — what they mean is caller-specific."""
     proc = subprocess.Popen(
@@ -108,6 +111,7 @@ def run_in_killable_group(argv, timeout: float, stdout=None, stderr=None,
         stderr=stderr if stderr is not None else subprocess.DEVNULL,
         start_new_session=True,
         cwd=cwd,
+        env=env,
     )
     timed_out = not _wait_exited_unreaped(proc.pid, timeout)
     # Whether the child exited (now a zombie — still pinning the pgid) or

@@ -119,10 +119,37 @@ Environment variables (read at first import):
                         ``TDX_METRICS_PATH`` every interval, so a fleet
                         scraper sees live values instead of exit-time ones
                         (0 disables; see docs/observability.md).
+``TDX_OBS_PORT``        Live telemetry HTTP port
+                        (:mod:`torchdistx_tpu.observe.httpd`): when set, a
+                        stdlib ThreadingHTTPServer daemon serves
+                        ``/metrics`` (Prometheus text), ``/healthz`` /
+                        ``/readyz`` (bring-up + liveness), ``/slo``, and
+                        ``/flight`` — armed lazily on the first telemetry
+                        emission, like the periodic exporter.  ``0`` binds
+                        an ephemeral port and writes it to
+                        ``TDX_OBS_PORT_FILE`` (unset disables; see
+                        docs/observability.md §Live endpoints).
+``TDX_OBS_BIND``        Bind address for the live HTTP daemon (default
+                        ``127.0.0.1`` — local scrapes only; widen
+                        deliberately, e.g. ``0.0.0.0``, on trusted
+                        networks).
+``TDX_OBS_PORT_FILE``   Where the daemon writes its bound port (one ASCII
+                        integer, atomic rename) — required reading for
+                        ``TDX_OBS_PORT=0``.  ``%h``/``%p`` expand like
+                        ``TDX_METRICS_PATH``; default
+                        ``<tempdir>/tdx-obs-%p.port``.
 ``TDX_FAULT_PLAN``      Deterministic fault-injection plan for the elastic
                         training stack (:mod:`torchdistx_tpu.chaos`), e.g.
                         ``"step@4=raise;save@2=corrupt:truncate"``
                         ("" disables; see docs/robustness.md).
+``TDX_TRACE_PARENT``    Causal trace-context handoff (NOT a Config field —
+                        read once by :mod:`torchdistx_tpu.observe.tracectx`
+                        at adoption): a parent process that spawns work
+                        stamps ``trace_id:flow_id`` into the child's
+                        environment so the merged Chrome trace draws flow
+                        arrows across pids/hosts.  Set by the spawners
+                        (bench phases, ``warm_cache --spawn-shards``), not
+                        by operators.
 ======================  ====================================================
 
 Per-scope telemetry works like every other knob::
@@ -153,6 +180,9 @@ class Config:
     metrics_path: Optional[str] = None
     flight_dir: Optional[str] = None
     metrics_export_s: float = 0.0
+    obs_port: Optional[int] = None
+    obs_bind: str = "127.0.0.1"
+    obs_port_file: Optional[str] = None
     fault_plan: Optional[str] = None
     materialize_pipeline: str = "auto"
     compile_workers: int = 0
@@ -177,6 +207,12 @@ def _from_env() -> Config:
         metrics_path=os.environ.get("TDX_METRICS_PATH", "") or None,
         flight_dir=os.environ.get("TDX_FLIGHT_DIR", "") or None,
         metrics_export_s=float(os.environ.get("TDX_METRICS_EXPORT_S", "0")),
+        obs_port=(
+            int(os.environ["TDX_OBS_PORT"])
+            if os.environ.get("TDX_OBS_PORT", "") != "" else None
+        ),
+        obs_bind=os.environ.get("TDX_OBS_BIND", "") or "127.0.0.1",
+        obs_port_file=os.environ.get("TDX_OBS_PORT_FILE", "") or None,
         fault_plan=os.environ.get("TDX_FAULT_PLAN", "") or None,
         materialize_pipeline=os.environ.get("TDX_MATERIALIZE_PIPELINE", "auto"),
         compile_workers=int(os.environ.get("TDX_COMPILE_WORKERS", "0")),

@@ -66,10 +66,11 @@ import os
 import threading
 from typing import Optional
 
-from . import costmodel, flightrec, slo
+from . import costmodel, flightrec, health, httpd, slo, tracectx
 from .metrics import Counter, Counters, Gauge, Histogram, JsonlSink
 from .spans import Span, Tracer, _NOOP_SPAN, set_drop_hook, set_flight_feed
 from .step import StepMeter, peak_tflops_for
+from .tracectx import trace_context
 
 __all__ = [
     "Counter",
@@ -89,12 +90,17 @@ __all__ = [
     "flightrec",
     "flush",
     "gauge",
+    "health",
     "histogram",
+    "httpd",
     "instant",
     "peak_tflops_for",
     "reset",
     "slo",
     "span",
+    "stop_background",
+    "trace_context",
+    "tracectx",
     "tracer",
 ]
 
@@ -104,6 +110,7 @@ _COUNTERS = Counters(on_sample=lambda name, value: _TRACER.counter_sample(name, 
 _FORCED: Optional[bool] = None
 _flush_lock = threading.Lock()
 _autoflush_armed = False
+_atexit_registered = False
 _flight_armed = False
 _last_counters_sig: Optional[str] = None
 _config = None  # cached module ref: enabled() sits on record_op's hot path
@@ -263,7 +270,7 @@ def reset() -> None:
 def _arm_autoflush() -> None:
     # Registered on the first emission, not at import: a process that
     # never records anything must not add an exit hook.
-    global _autoflush_armed, _flight_armed
+    global _autoflush_armed, _atexit_registered, _flight_armed
     if not _flight_armed and flightrec.armed():
         # First emission under a bound flight dir: tee the tracer into
         # the recorder's independent ring and install the
@@ -276,12 +283,35 @@ def _arm_autoflush() -> None:
     if _autoflush_armed:
         return
     _autoflush_armed = True
-    atexit.register(_atexit_flush)
+    if not _atexit_registered:
+        # atexit stays registered for the process even after a
+        # stop_background(): re-arming must not stack duplicate hooks.
+        _atexit_registered = True
+        atexit.register(_atexit_flush)
+    # Adopt the cross-process trace context now — the first telemetry
+    # emission is exactly when a spawned child starts producing spans,
+    # so its inherited flow edge binds to its first real work.
+    tracectx.adopt(_TRACER)
     # TDX_METRICS_EXPORT_S is a general knob, not a serving one: any
     # telemetry-producing process (train, materialize) gets the
     # periodic exporter on first emission (no-op when the knob is 0;
     # ServeEngine re-calls to attach its SLO windows).
     slo.ensure_exporter()
+    # Same lazy-opt-in shape for the live HTTP plane (no-op when
+    # TDX_OBS_PORT is unset).
+    httpd.ensure_httpd()
+
+
+def stop_background() -> None:
+    """Stop and join every background thread the observe layer armed
+    (periodic exporter, telemetry httpd) and de-latch the arming flag so
+    the NEXT emission can re-arm them fresh — the teardown half of the
+    lazy-arming lifecycle (tests, orderly shutdown before re-binding
+    config)."""
+    global _autoflush_armed
+    slo.stop_exporter()
+    httpd.stop_httpd()
+    _autoflush_armed = False
 
 
 def _atexit_flush() -> None:
@@ -289,3 +319,7 @@ def _atexit_flush() -> None:
         flush()
     except Exception:
         pass  # exit paths never raise from telemetry
+    try:
+        stop_background()
+    except Exception:
+        pass

@@ -45,7 +45,13 @@ import traceback
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 1
+# v2 adds causal identity: "trace_id" (the run tree's id from
+# observe.tracectx, shared with the Chrome trace label) and
+# "trace_parent" (the raw inherited TDX_TRACE_PARENT, None at the root)
+# — so a dump can be matched to the exact run and the exact spawn edge
+# that produced it.  v1 dumps stay readable: validate() accepts both.
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = (1, 2)
 
 # Required top-level keys of a dump — tools/tdx_trace.py carries its own
 # copy (it must stay stdlib-importable without this package); keep the
@@ -54,6 +60,7 @@ SCHEMA_KEYS = (
     "schema", "reason", "time", "pid", "host", "events", "config",
     "env", "counter_snapshots",
 )
+SCHEMA_KEYS_V2 = ("trace_id",)
 
 _DEFAULT_RING = 4096
 _MAX_COUNTER_SNAPS = 8
@@ -209,12 +216,17 @@ def dump(reason: str, **context) -> Optional[str]:
         seq = _seq
     try:
         snapshot_counters()
+        from .tracectx import trace_context
+
+        ctx = trace_context()
         doc = {
             "schema": SCHEMA_VERSION,
             "reason": reason,
             "time": time.time(),
             "pid": os.getpid(),
             "host": _hostname(),
+            "trace_id": ctx.trace_id,
+            "trace_parent": ctx.parent,
             "events": ring_events(),
             "dropped_events": _tracer_dropped(),
             "config": _config_dict(),
@@ -241,8 +253,13 @@ def validate(doc: dict) -> List[str]:
     """Schema check of a parsed dump; returns the list of problems
     (empty = valid).  The CLI mirrors this check stdlib-side."""
     problems = [f"missing key {k!r}" for k in SCHEMA_KEYS if k not in doc]
-    if doc.get("schema") not in (SCHEMA_VERSION,):
-        problems.append(f"unknown schema version {doc.get('schema')!r}")
+    ver = doc.get("schema")
+    if ver not in SUPPORTED_SCHEMAS:
+        problems.append(f"unknown schema version {ver!r}")
+    elif isinstance(ver, int) and ver >= 2:
+        problems.extend(
+            f"missing key {k!r}" for k in SCHEMA_KEYS_V2 if k not in doc
+        )
     if not isinstance(doc.get("events"), list):
         problems.append("events is not a list")
     return problems

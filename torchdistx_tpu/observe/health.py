@@ -1,0 +1,125 @@
+"""Bring-up states and liveness heartbeats behind /healthz + /readyz.
+
+Two small registries, both process-wide and thread-safe:
+
+* **component states** — a named component walks an explicit bring-up
+  state machine; the serve engine reports ``spin_up`` → ``warming`` →
+  ``serving`` around replica bring-up (:func:`..serve.spin_up_replica`).
+  ``/readyz`` returns 200 only when every registered component is in a
+  READY state (``serving`` / ``ready``) — so a load balancer cannot
+  route to a replica whose program set is still compiling/fetching.  A
+  process with no registered components is trivially ready (a bench or
+  train process has no bring-up gate).
+* **heartbeats** — a loop that can wedge (the elastic step loop, under
+  its step watchdog) beats once per iteration with a period hint;
+  ``/healthz`` returns 503 when any heartbeat is older than its
+  allowance (``max(4 × period_hint, 15 s)`` — generous vs the watchdog
+  so a single slow step never flaps the probe).
+
+The registries hold plain floats/strings under one lock — reporting a
+state or a beat is nanoseconds, covered by the same <2% overhead gate
+as the rest of the telemetry layer (tests/test_live_ops.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "READY_STATES",
+    "beat",
+    "liveness",
+    "readiness",
+    "reset",
+    "set_state",
+    "snapshot",
+]
+
+# Terminal bring-up states that count as ready for /readyz.
+READY_STATES = ("serving", "ready")
+
+_MIN_ALLOWANCE_S = 15.0
+
+_lock = threading.Lock()
+_states: Dict[str, Tuple[str, float]] = {}          # name -> (state, since)
+_beats: Dict[str, Tuple[float, Optional[float]]] = {}  # name -> (t, hint)
+
+
+def set_state(component: str, state: str) -> None:
+    """Report a component's bring-up state (e.g. ``set_state("serve",
+    "warming")``); also mirrored as a trace instant so the state walk
+    shows up on the timeline."""
+    with _lock:
+        _states[component] = (state, time.monotonic())
+    from . import enabled, instant
+
+    if enabled():
+        instant(f"{component}.state", category="health", state=state)
+
+
+def beat(name: str, period_hint_s: Optional[float] = None) -> None:
+    """One liveness heartbeat; ``period_hint_s`` sizes the staleness
+    allowance (``max(4 × hint, 15 s)``)."""
+    with _lock:
+        _beats[name] = (time.monotonic(), period_hint_s)
+
+
+def snapshot() -> dict:
+    """States + heartbeat ages as one JSON-ready dict."""
+    now = time.monotonic()
+    with _lock:
+        states = {
+            name: {"state": st, "for_s": round(now - since, 3)}
+            for name, (st, since) in _states.items()
+        }
+        beats = {
+            name: {
+                "age_s": round(now - t, 3),
+                **({"period_hint_s": hint} if hint is not None else {}),
+            }
+            for name, (t, hint) in _beats.items()
+        }
+    return {"states": states, "heartbeats": beats}
+
+
+def _allowance(hint: Optional[float]) -> float:
+    return max(4.0 * hint, _MIN_ALLOWANCE_S) if hint else _MIN_ALLOWANCE_S
+
+
+def liveness() -> Tuple[bool, dict]:
+    """(alive, detail) for /healthz: alive unless a heartbeat went
+    stale.  A process that never beats is alive by definition — the
+    probe's job is catching a wedged LOOP, not requiring one."""
+    now = time.monotonic()
+    detail = snapshot()
+    stale = {}
+    with _lock:
+        for name, (t, hint) in _beats.items():
+            age = now - t
+            if age > _allowance(hint):
+                stale[name] = round(age, 3)
+    if stale:
+        detail["stale"] = stale
+    return (not stale), detail
+
+
+def readiness() -> Tuple[bool, dict]:
+    """(ready, detail) for /readyz: every registered component must be
+    in a READY state; none registered → trivially ready."""
+    detail = snapshot()
+    not_ready = {
+        name: info["state"] for name, info in detail["states"].items()
+        if info["state"] not in READY_STATES
+    }
+    if not_ready:
+        detail["not_ready"] = not_ready
+    return (not not_ready), detail
+
+
+def reset() -> None:
+    """Drop all states and heartbeats (tests)."""
+    with _lock:
+        _states.clear()
+        _beats.clear()

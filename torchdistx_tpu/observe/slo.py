@@ -29,10 +29,12 @@ import math
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, Optional, Sequence, Tuple
 
-__all__ = ["ServeSLO", "SloWindow", "ensure_exporter", "stop_exporter"]
+__all__ = ["ServeSLO", "SloWindow", "ensure_exporter", "snapshot_all",
+           "stop_exporter"]
 
 _DEFAULT_WINDOW_S = 300.0
 _DEFAULT_MAX_SAMPLES = 4096
@@ -103,11 +105,18 @@ class ServeSLO:
 
     METRICS = ("ttft", "token", "queue_wait")
 
-    def __init__(self, window_s: float = _DEFAULT_WINDOW_S):
+    def __init__(self, window_s: float = _DEFAULT_WINDOW_S,
+                 name: str = "serve"):
+        self.name = name
         self.windows: Dict[str, SloWindow] = {
             m: SloWindow(window_s) for m in self.METRICS
         }
         self._published: set = set()
+        # Live-registry registration (weak: a test's short-lived engine
+        # must not pin its SLO windows for the process lifetime).  The
+        # /slo endpoint and snapshot_all() read it back.
+        with _registry_lock:
+            _registry[name] = self
 
     def observe_ttft(self, seconds: float) -> None:
         self.windows["ttft"].observe(seconds)
@@ -154,6 +163,25 @@ class ServeSLO:
                 gauge(f"tdx.serve.slo.{name}_window_count").set(0)
             self._published &= set(snap)
         return snap
+
+
+# -- live registry -----------------------------------------------------------
+
+# name → live ServeSLO (weak values: engines come and go; the registry
+# must never keep one alive).  Same-name re-registration is last-wins —
+# one replica per process is the deployment shape.
+_registry_lock = threading.Lock()
+_registry: "weakref.WeakValueDictionary[str, ServeSLO]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def snapshot_all() -> Dict[str, Dict[str, Dict[str, float]]]:
+    """{slo_name: {metric: {"p50": ..., "count": n}}} for every live
+    :class:`ServeSLO` — what the ``/slo`` endpoint serves."""
+    with _registry_lock:
+        slos = dict(_registry)
+    return {name: slo.snapshot() for name, slo in sorted(slos.items())}
 
 
 # -- periodic exporter -------------------------------------------------------

@@ -37,6 +37,11 @@ _PERF0 = time.perf_counter()
 _flight_feed = None
 _drop_hook = None
 
+# Set by observe.tracectx when a trace context is minted/adopted: stamped
+# into the Chrome export as a process label so a merged Perfetto view
+# groups every process of one causal run under the same trace id.
+_trace_label: Optional[str] = None
+
 
 def set_flight_feed(fn) -> None:
     global _flight_feed
@@ -46,6 +51,11 @@ def set_flight_feed(fn) -> None:
 def set_drop_hook(fn) -> None:
     global _drop_hook
     _drop_hook = fn
+
+
+def set_trace_label(label: Optional[str]) -> None:
+    global _trace_label
+    _trace_label = label
 
 
 def now_us() -> float:
@@ -140,6 +150,7 @@ class Tracer:
         self._tls = threading.local()
         self._seq = 0
         self.dropped = 0
+        self._pending_flow: Optional[int] = None
         self.events: "deque[dict]" = deque(maxlen=max_events)
 
     # -- recording -------------------------------------------------------
@@ -155,6 +166,41 @@ class Tracer:
             "ts": now_us(), "pid": _pid(), "tid": _tid(),
             **({"args": dict(args)} if args else {}),
         })
+
+    # -- flow events (causal arrows across pids/hosts) -------------------
+
+    def flow_start(self, name: str = "tdx.flow") -> int:
+        """Emit a Chrome flow-start (``ph:"s"``) at the current point —
+        call inside an open span so the arrow's tail binds to it — and
+        return the flow id to hand to the child (``TDX_TRACE_PARENT``).
+        Ids are pid-salted so several spawners of one run cannot
+        collide in the merged trace."""
+        with self._lock:
+            self._seq += 1
+            flow_id = ((_pid() & 0x3FFFFF) << 20) | (self._seq & 0xFFFFF)
+        self._record({
+            "name": name, "cat": "flow", "ph": "s", "id": flow_id,
+            "ts": now_us(), "pid": _pid(), "tid": _tid(),
+        })
+        return flow_id
+
+    def flow_finish(self, flow_id: int, *, ts: Optional[float] = None,
+                    name: str = "tdx.flow") -> None:
+        """Emit the matching flow-finish (``ph:"f"``, bound to the slice
+        enclosing ``ts``) — the arrow's head."""
+        self._record({
+            "name": name, "cat": "flow", "ph": "f", "bp": "e",
+            "id": flow_id, "ts": now_us() if ts is None else ts,
+            "pid": _pid(), "tid": _tid(),
+        })
+
+    def bind_flow_on_first_span(self, flow_id: int) -> None:
+        """Defer the flow-finish to the FIRST span this tracer closes:
+        the ``f`` event is stamped just inside that span, so the causal
+        arrow from the parent's spawn span lands on the first real work
+        the child did (e.g. a shard's compile span) instead of on an
+        artificial adoption marker."""
+        self._pending_flow = flow_id
 
     def counter_sample(self, name: str, value: float) -> None:
         """A Chrome-trace counter ('C') sample — gauges call this on every
@@ -186,6 +232,18 @@ class Tracer:
             stack.remove(span)
         args = dict(span.args)
         args["self_us"] = round(max(0.0, span.dur_us - span._child_us), 1)
+        pending = self._pending_flow
+        if pending is not None:
+            # Inherited trace context: land the parent's causal arrow
+            # just inside this first-closed span (ts strictly within the
+            # slice, so Perfetto's enclosing-slice binding resolves it).
+            self._pending_flow = None
+            self._record({
+                "name": "tdx.flow", "cat": "flow", "ph": "f", "bp": "e",
+                "id": pending,
+                "ts": span.t0_us + min(1.0, max(0.0, span.dur_us) / 2),
+                "pid": _pid(), "tid": _tid(),
+            })
         self._record({
             "name": span.name, "cat": span.category, "ph": "X",
             "ts": span.t0_us, "dur": span.dur_us, "pid": _pid(),
@@ -259,6 +317,14 @@ class Tracer:
             "name": "process_name", "ph": "M", "pid": _pid(), "tid": 0,
             "args": {"name": f"torchdistx_tpu pid={_pid()}"},
         })
+        if _trace_label:
+            # Same label on every process of one causal run: a merged
+            # Perfetto view groups them (and tdx_trace.py joins dumps to
+            # traces) by trace id.
+            out.append({
+                "name": "process_labels", "ph": "M", "pid": _pid(),
+                "tid": 0, "args": {"labels": _trace_label},
+            })
         with self._lock:
             dropped = self.dropped
         if dropped:

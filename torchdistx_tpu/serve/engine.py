@@ -548,6 +548,10 @@ def spin_up_replica(
         cfg = model
         family = family or "llama"
     t0 = time.perf_counter()
+    # Bring-up state machine behind /readyz (observe.health): a load
+    # balancer must not route here until the program set is
+    # compiled/fetched and warm.
+    observe.health.set_state("serve", "spin_up")
     with observe.span(
         "serve.spin_up", category="serve", family=family,
         warm=bool(warm),
@@ -588,10 +592,12 @@ def spin_up_replica(
         # trace; hand it to the engine so warmup/lazy compiles reuse it.
         engine._spec_cache = {s.name: s for s in specs if s.name != "init"}
         outcomes = {"init": init_outcome}
+        observe.health.set_state("serve", "warming")
         if warm:
             outcomes.update(engine.warmup())
         engine.bring_up_outcomes = outcomes
         engine.bring_up_seconds = time.perf_counter() - t0
+        observe.health.set_state("serve", "serving")
         sp.set(seconds=round(engine.bring_up_seconds, 3), **{
             f"cache_{k}": v for k, v in outcomes.items()
         })

@@ -711,6 +711,10 @@ def run_elastic(
             save(0, state)
 
         while True:
+            # Liveness heartbeat behind /healthz: a wedged step that the
+            # watchdog hasn't killed yet (or a hang with no deadline set)
+            # goes stale here and flips the probe to 503.
+            observe.health.beat("elastic", period_hint_s=step_deadline)
             if drain["requested"]:
                 drain_ok = _drain_now()
                 drained = True
