@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke reshard-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -47,12 +47,13 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke serve-smoke obs-smoke
+chaos-test: registry-smoke serve-smoke obs-smoke reshard-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py \
 	    tests/test_flightrec.py tests/test_materialize_transport.py \
 	    tests/test_live_ops.py tests/test_bench_trend.py \
+	    tests/test_reshard.py \
 	    -q -p no:cacheprovider
 
 # Observability smoke (docs/observability.md §Flight recorder): an
@@ -81,6 +82,16 @@ serve-smoke:
 # outputs.  CPU, bounded; part of `make chaos-test`.
 registry-smoke:
 	timeout -k 10 420 bash scripts/registry_smoke.sh
+
+# Topology-migration smoke (docs/robustness.md §Resharding): save a
+# training state under a 1x4 fsdp layout, reshard_ctl.py-apply it to
+# 2x2 gspmd2d AND 1x2 fsdp layouts (exit codes + independent
+# leaf-by-leaf bitwise verify, plus a corrupted-destination negative
+# gate), then a FRESH process restores the 2x2 result through the
+# elastic loop and trains a step.  CPU, bounded; part of
+# `make chaos-test`.
+reshard-smoke:
+	timeout -k 10 420 bash scripts/reshard_smoke.sh
 
 # One short materialize-recovery soak cycle under tier-1 constraints
 # (CPU, bounded wall clock): drives the self-healing materialization

@@ -1270,6 +1270,81 @@ def phase_materialize_bandwidth() -> dict:
     return out
 
 
+def phase_reshard() -> dict:
+    """Offline topology-migration throughput (docs/robustness.md
+    §Resharding): save a transport-bound checkpoint under an fsdp=4
+    layout, rechunk-copy it to a 2x2 gspmd2d layout with
+    :func:`torchdistx_tpu.reshard.reshard_checkpoint` (post-copy bitwise
+    verify INCLUDED in the timed region — the contract never commits an
+    unverified destination, so an honest rate cannot exclude it), and
+    report ``reshard_gbps`` over the bytes moved plus the bounded host
+    staging peak.  Slab fills, not RNG: the engine's job is moving and
+    rechunking bytes through a budgeted staging buffer, so the roofline
+    target is disk+memcpy, not the ALU."""
+    import shutil
+    import tempfile
+
+    jax = _virtual_cpu_init(8)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchdistx_tpu import reshard
+    from torchdistx_tpu.parallel.mesh import make_mesh
+    from torchdistx_tpu.parallel.sharding import fsdp_plan, gspmd_2d_plan
+    from torchdistx_tpu.utils.checkpoint import (
+        leaf_storage_name, save_checkpoint,
+    )
+
+    total_mb = int(os.environ.get("TDX_RESHARD_BENCH_MB", "128"))
+    n_slabs = int(os.environ.get("TDX_RESHARD_BENCH_SLABS", "16"))
+    reps = int(os.environ.get("TDX_RESHARD_BENCH_REPEATS", "2"))
+    rows = max(8, total_mb * (1 << 20) // 4 // n_slabs // 256)
+
+    mesh_a = make_mesh({"fsdp": 4}, devices=jax.devices()[:4])
+    mesh_b = make_mesh({"fsdp": 2, "tp": 2}, devices=jax.devices()[:4])
+    plan_a, plan_b = fsdp_plan(min_size=1), gspmd_2d_plan(min_size=1)
+    state = {
+        f"slab_{i}": jnp.full((rows + 8 * i, 256), float(i + 1), jnp.float32)
+        for i in range(n_slabs)
+    }
+    flat, td = jax.tree_util.tree_flatten_with_path(state)
+    state = jax.tree_util.tree_unflatten(td, [
+        jax.device_put(
+            leaf, plan_a.sharding_for(leaf_storage_name(kp), leaf.shape, mesh_a))
+        for kp, leaf in flat
+    ])
+
+    d = tempfile.mkdtemp(prefix="tdx_bench_reshard_")
+    try:
+        save_checkpoint(os.path.join(d, "src"), state)
+        best = None
+        bytes_moved = peak = chunks = None
+        for r in range(reps):
+            dst = os.path.join(d, f"dst_{r}")
+            t0 = time.perf_counter()
+            reshard.reshard_checkpoint(
+                os.path.join(d, "src"), plan_b, mesh_b, dst)
+            dt = time.perf_counter() - t0
+            pl = reshard.plan_reshard(os.path.join(d, "src"), plan_b, mesh_b)
+            bytes_moved, chunks = pl.moved_bytes, pl.total_chunks
+            peak = reshard.last_transfer_peak_bytes()
+            best = dt if best is None else min(best, dt)
+            shutil.rmtree(dst, ignore_errors=True)
+        total = sum(np.asarray(v).nbytes for v in jax.tree_util.tree_leaves(state))
+        return {
+            "reshard_gbps": total / best / 1e9,
+            "reshard_bytes_moved": bytes_moved,
+            "reshard_bytes_total": total,
+            "reshard_chunks": chunks,
+            "reshard_peak_host_bytes": peak,
+            "reshard_s": best,
+            "n_leaves": len(jax.tree_util.tree_leaves(state)),
+            "repeats": reps,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def phase_serving() -> dict:
     """Inference-serving phase (docs/serving.md): decode tokens/s
     through the continuous-batching engine, and time-to-first-token for
@@ -1762,6 +1837,7 @@ PHASES = {
     "train_mfu": phase_train_mfu,
     "materialize_pipeline": phase_materialize_pipeline,
     "materialize_bandwidth": phase_materialize_bandwidth,
+    "reshard": phase_reshard,
 }
 
 
@@ -2320,6 +2396,20 @@ def main() -> None:
     else:
         out["materialize_bandwidth_error"] = mb["error"][-160:]
 
+    rs = _run_phase("reshard", timeout=600.0)
+    rs.pop("_backend", None)  # host-side tensorstore copy: cpu by design
+    if "error" not in rs:
+        out["reshard"] = rs
+        # Promoted headline keys: the topology-migration rate and the
+        # bytes a mesh-shrink would move (docs/robustness.md
+        # §Resharding) — tracked by tools/bench_trend.py from r06 on.
+        if rs.get("reshard_gbps") is not None:
+            out["reshard_gbps"] = rs["reshard_gbps"]
+        if rs.get("reshard_bytes_moved") is not None:
+            out["reshard_bytes_moved"] = rs["reshard_bytes_moved"]
+    else:
+        out["reshard_error"] = rs["error"][-160:]
+
     bb = _run_phase("pp_bubble", timeout=120.0)
     bb.pop("_backend", None)  # static schedule analysis: no backend
     if "error" not in bb:
@@ -2382,6 +2472,7 @@ _HEADLINE_KEYS = (
     "headline_cache_expired_s",
     "materialize_gbps", "materialize_link_utilization", "pipeline_speedup",
     "materialize_bandwidth_gbps", "materialize_bandwidth_utilization",
+    "reshard_gbps", "reshard_bytes_moved",
     "train_mfu", "train_mfu_xla", "train_tokens_per_s", "train_step_ms",
     "train_stale_s", "train_mfu_skipped", "train_mfu_error",
     "flash_mfu", "flash_speedup", "flash_bwd_mfu", "flash_bwd_speedup",

@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# Reshard smoke (make reshard-smoke, docs/robustness.md §Resharding):
+# save a training state (params + adamw optimizer state) under a 1x4
+# fsdp layout, migrate it offline with tools/reshard_ctl.py to a 2x2
+# gspmd2d layout AND a 1x2 fsdp layout, gate each apply on its exit
+# code plus an independent leaf-by-leaf bitwise verify, then prove the
+# destination is a NORMAL checkpoint: a FRESH process restores it onto
+# the new mesh through the elastic loop and trains a step.  CPU-only,
+# bounded, exercises real process boundaries (the in-process
+# equivalents live in tests/test_reshard.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+TMP=$(mktemp -d /tmp/tdx_reshard_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+
+echo "== save a sharded training state under fsdp=4 =="
+python - "$TMP" <<'EOF'
+import sys
+import jax, jax.numpy as jnp, optax
+from torchdistx_tpu.parallel.mesh import make_mesh
+from torchdistx_tpu.parallel.sharding import fsdp_plan
+from torchdistx_tpu.utils.checkpoint import (
+    leaf_storage_name, read_manifest, save_checkpoint)
+
+d = sys.argv[1]
+mesh = make_mesh({"fsdp": 4}, devices=jax.devices()[:4])
+plan = fsdp_plan(min_size=1)
+params = {"dense": {"kernel": jnp.arange(2048, dtype=jnp.float32).reshape(64, 32),
+                    "bias": jnp.linspace(0, 1, 32).astype(jnp.bfloat16)}}
+state = {"params": params, "opt": optax.adamw(3e-4).init(params),
+         "step": jnp.int32(0)}
+flat, td = jax.tree_util.tree_flatten_with_path(state)
+state = jax.tree_util.tree_unflatten(td, [
+    jax.device_put(l, plan.sharding_for(leaf_storage_name(kp), l.shape, mesh))
+    for kp, l in flat])
+save_checkpoint(d + "/src", state)
+topo = read_manifest(d + "/src")["topology"]
+assert topo["mesh_axes"] == {"fsdp": 4}, topo
+print("  OK: saved under", topo["mesh_axes"], "digest", topo["plan_digest"])
+EOF
+
+echo "== plan (dry run): schedule + byte totals =="
+python tools/reshard_ctl.py plan "$TMP/src" --mesh fsdp=2,tp=2 --plan gspmd2d
+
+echo "== apply fsdp=4 -> fsdp=2,tp=2 (gspmd2d) =="
+python tools/reshard_ctl.py apply "$TMP/src" "$TMP/dst_2x2" \
+    --mesh fsdp=2,tp=2 --plan gspmd2d
+echo "== apply fsdp=4 -> fsdp=2 =="
+python tools/reshard_ctl.py apply "$TMP/src" "$TMP/dst_1x2" \
+    --mesh fsdp=2 --plan fsdp
+
+echo "== independent leaf-by-leaf bitwise verify of both destinations =="
+python tools/reshard_ctl.py verify "$TMP/src" "$TMP/dst_2x2"
+python tools/reshard_ctl.py verify "$TMP/src" "$TMP/dst_1x2"
+
+echo "== a corrupted destination must FAIL verify (exit 1) =="
+python - "$TMP" <<'EOF'
+import sys
+from torchdistx_tpu.chaos import corrupt_checkpoint
+print("  damaged:", corrupt_checkpoint(sys.argv[1] + "/dst_1x2", mode="flip"))
+EOF
+if python tools/reshard_ctl.py verify "$TMP/src" "$TMP/dst_1x2"; then
+    echo "corrupted destination passed verify"; exit 1
+fi
+echo "  OK: damage detected, exit 1"
+
+echo "== fresh process: elastic restore onto the 2x2 mesh + train a step =="
+python - "$TMP" <<'EOF'
+import sys
+import jax, jax.numpy as jnp, numpy as np
+from torchdistx_tpu.parallel.mesh import make_mesh
+from torchdistx_tpu.parallel.sharding import gspmd_2d_plan
+from torchdistx_tpu.utils.checkpoint import leaf_storage_name
+from torchdistx_tpu.utils.failures import run_elastic
+import optax
+
+d = sys.argv[1]
+mesh = make_mesh({"fsdp": 2, "tp": 2}, devices=jax.devices()[:4])
+plan = gspmd_2d_plan(min_size=1)
+params = {"dense": {"kernel": jnp.zeros((64, 32), jnp.float32),
+                    "bias": jnp.zeros((32,), jnp.bfloat16)}}
+state = {"params": params, "opt": optax.adamw(3e-4).init(params),
+         "step": jnp.int32(0)}
+flat, td = jax.tree_util.tree_flatten_with_path(state)
+state = jax.tree_util.tree_unflatten(td, [
+    jax.device_put(l, plan.sharding_for(leaf_storage_name(kp), l.shape, mesh))
+    for kp, l in flat])
+
+opt = optax.adamw(3e-4)
+
+def stepf(st, batch):
+    def loss_fn(p):
+        return jnp.mean((p["dense"]["kernel"].sum(axis=0)
+                         + p["dense"]["bias"].astype(jnp.float32) - batch) ** 2)
+    g = jax.grad(loss_fn)(st["params"])
+    upd, new_opt = opt.update(g, st["opt"], st["params"])
+    return {"params": optax.apply_updates(st["params"], upd),
+            "opt": new_opt, "step": st["step"] + 1}, {}
+
+# Bitwise gate from inside the restoring process: the resharded
+# checkpoint restores the ORIGINAL values under the new layout.
+from torchdistx_tpu.utils.checkpoint import restore_checkpoint
+pre = restore_checkpoint(d + "/dst_2x2", target=state)
+want = np.arange(2048, dtype=np.float32).reshape(64, 32)
+got = np.asarray(pre["params"]["dense"]["kernel"])
+assert np.array_equal(got.view(np.uint8), want.view(np.uint8))
+
+# The checkpoint dir holds the RESHARDED 2x2 checkpoint under the name
+# run_elastic scans for.
+import shutil, os
+ck = d + "/elastic"
+os.makedirs(ck)
+shutil.copytree(d + "/dst_2x2", ck + "/step_0")
+out, steps, _ = run_elastic(stepf, state, [jnp.float32(1.0)],
+                            checkpoint_dir=ck, checkpoint_every=1000,
+                            resume=True, probe_on_restart=False)
+assert steps == 1, steps
+k = out["params"]["dense"]["kernel"]
+assert int(out["step"]) == 1
+assert not np.array_equal(np.asarray(k), np.zeros_like(k))  # trained
+# Restored under the 2x2 layout before the step ran: the original
+# values came through the reshard, not the zero init.
+print("  OK: restored on", dict(k.sharding.mesh.shape), "and trained a step")
+EOF
+
+echo "reshard-smoke OK"

@@ -75,6 +75,11 @@ _HOST_PHASES = {
         "device_put_batches": 0, "warm_execute_s": 0.077,
         "backend": "cpu", "_backend": "cpu"},
     "pp_bubble": {"schedule_analysis": {"pp4_v2_m8": {"interleaved_ticks": 26}}},
+    "reshard": {
+        "n_leaves": 16, "repeats": 2, "reshard_s": 0.41,
+        "reshard_bytes_moved": 134217728, "reshard_bytes_total": 134217904,
+        "reshard_chunks": 64, "reshard_peak_host_bytes": 16777216,
+        "reshard_gbps": 0.327, "backend": "cpu", "_backend": "cpu"},
     "serving": {
         "bring_up_cold_s": 4.1, "ttft_cold_s": 4.13,
         "bring_up_warm_s": 0.77, "ttft_warm_s": 0.77,
@@ -145,6 +150,8 @@ def test_healthy_branch_headline_and_detail(bench):
     assert full["llama_1p9b_vs_baseline"] == round(266.0 / 2.6, 3)
     assert full["llama_big_param_dtype"] == "bfloat16"
     assert headline["pipeline_speedup"] == 1.408
+    assert headline["reshard_gbps"] == 0.327
+    assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208
     assert json.load(open(Path(bench.REPO) / "bench_full.json")) == full

@@ -1,0 +1,537 @@
+"""Elastic resharding: topology-migrating checkpoint redistribution.
+
+Covers the :mod:`torchdistx_tpu.reshard` contract (docs/robustness.md
+§Resharding):
+
+* offline ``reshard_checkpoint`` is bitwise-exact leaf-by-leaf — params
+  AND optimizer state, bfloat16 included — for shrink, grow, and
+  axis-reshape plan pairs;
+* the manifest topology block round-trips and old manifests without it
+  still verify;
+* ``run_elastic`` resume onto a different mesh reshards in-flight
+  (``needs_reshard`` routing) and continues the exact trajectory;
+* host memory during a transfer stays bounded by the chunk budget even
+  when a single leaf exceeds it;
+* injected ``reshard``-site chaos (raise / slow / corrupt) degrades and
+  never corrupts: source untouched, no committed destination, typed
+  :class:`ReshardError`;
+* the ``auto`` pipeline-executor spelling resolves per schedule size.
+
+The mesh pairs are carved out of the 8-device virtual CPU pool
+(conftest.py), so a "host count change" is a device-subset change —
+same trick the FSDP tests use.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchdistx_tpu import chaos, observe, reshard
+from torchdistx_tpu.parallel.mesh import make_mesh
+from torchdistx_tpu.parallel.sharding import (
+    ShardingPlan, fsdp_plan, gspmd_2d_plan, plan_digest, spec_str,
+)
+from torchdistx_tpu.reshard import ReshardError
+from torchdistx_tpu.utils.checkpoint import (
+    leaf_storage_name,
+    read_manifest,
+    restore_checkpoint,
+    save_checkpoint,
+    state_topology,
+    verify_checkpoint,
+)
+from torchdistx_tpu.utils.failures import run_elastic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(axes):
+    n = 1
+    for s in axes.values():
+        n *= s
+    return make_mesh(dict(axes), devices=jax.devices()[:n])
+
+
+def _state():
+    """Params + real adamw optimizer state + bf16 leaf + scalar step."""
+    params = {
+        "dense": {
+            "kernel": jnp.arange(96, dtype=jnp.float32).reshape(8, 12),
+            "bias": jnp.linspace(0.0, 1.0, 12).astype(jnp.bfloat16),
+        },
+        "embed": jnp.arange(64, dtype=jnp.float32).reshape(16, 4) * 0.25,
+    }
+    return {
+        "params": params,
+        "opt": optax.adamw(3e-4).init(params),
+        "step": jnp.int32(7),
+    }
+
+
+def _shard(tree, plan, mesh):
+    flat, td = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(td, [
+        jax.device_put(
+            leaf, plan.sharding_for(leaf_storage_name(kp), leaf.shape, mesh))
+        for kp, leaf in flat
+    ])
+
+
+def _bits(x):
+    return np.asarray(x).reshape(-1).view(np.uint8).tobytes()
+
+
+def _assert_bitwise(got, want):
+    assert jax.tree_util.tree_structure(got) == jax.tree_util.tree_structure(want)
+    for g, w in zip(jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)):
+        assert np.asarray(g).dtype == np.asarray(w).dtype
+        assert _bits(g) == _bits(w)
+
+
+# The three migration directions the acceptance criteria name.  Plans
+# use min_size=1 so every leaf — the 12-element bf16 bias included —
+# actually relayouts instead of staying replicated.
+_PAIRS = {
+    "shrink": ({"fsdp": 4}, fsdp_plan(min_size=1),
+               {"fsdp": 2}, fsdp_plan(min_size=1)),
+    "grow": ({"fsdp": 2}, fsdp_plan(min_size=1),
+             {"fsdp": 4}, fsdp_plan(min_size=1)),
+    "axis_reshape": ({"fsdp": 4}, fsdp_plan(min_size=1),
+                     {"fsdp": 2, "tp": 2}, gspmd_2d_plan(min_size=1)),
+}
+
+
+class TestOfflineReshard:
+    @pytest.mark.parametrize("pair", sorted(_PAIRS))
+    def test_bitwise_roundtrip(self, tmp_path, pair):
+        axes_a, plan_a, axes_b, plan_b = _PAIRS[pair]
+        mesh_a, mesh_b = _mesh(axes_a), _mesh(axes_b)
+        base = _state()
+        src = tmp_path / "src"
+        save_checkpoint(src, _shard(base, plan_a, mesh_a))
+
+        dst = reshard.reshard_checkpoint(src, plan_b, mesh_b, tmp_path / "dst")
+        ok, reason = verify_checkpoint(dst)
+        assert ok, reason
+        ok, reason = reshard.verify_reshard(src, dst)
+        assert ok, reason
+
+        # The destination is a NORMAL checkpoint: plain restore with a
+        # plan-B target returns the exact original values.
+        restored = restore_checkpoint(dst, target=_shard(base, plan_b, mesh_b))
+        _assert_bitwise(restored, base)
+        # ... laid out as plan B says, not plan A.
+        k = restored["params"]["dense"]["kernel"]
+        assert k.sharding == plan_b.sharding_for(
+            "params.dense.kernel", k.shape, mesh_b)
+
+    def test_topology_block_written_and_digest_stable(self, tmp_path):
+        mesh = _mesh({"fsdp": 4})
+        state = _shard(_state(), fsdp_plan(min_size=1), mesh)
+        save_checkpoint(tmp_path / "ck", state)
+        topo = read_manifest(tmp_path / "ck")["topology"]
+        assert topo["mesh_axes"] == {"fsdp": 4}
+        assert topo["specs"]["params.dense.kernel"] == spec_str(
+            fsdp_plan(min_size=1).spec_for("params.dense.kernel", (8, 12), mesh))
+        assert topo["plan_digest"] == plan_digest(
+            topo["mesh_axes"], topo["specs"])
+        assert state_topology(state) == topo
+
+    def test_old_manifest_without_topology_still_verifies(self, tmp_path,
+                                                          monkeypatch):
+        # Simulate a checkpoint written by PRE-topology code: the save
+        # path records no topology block (editing the manifest after the
+        # fact would break the commit marker's checksum — by design).
+        from torchdistx_tpu.utils import checkpoint as ckpt
+        monkeypatch.setattr(ckpt, "state_topology", lambda state: None)
+        mesh = _mesh({"fsdp": 2})
+        state = _shard(_state(), fsdp_plan(min_size=1), mesh)
+        save_checkpoint(tmp_path / "ck", state)
+        monkeypatch.undo()
+        man = json.loads((tmp_path / "ck" / "tdx_manifest.json").read_text())
+        assert "topology" not in man
+        ok, reason = verify_checkpoint(tmp_path / "ck")
+        assert ok, reason
+        # No topology record -> no opinion -> plain restore path.
+        assert reshard.needs_reshard(tmp_path / "ck", state) is False
+        restored = restore_checkpoint(tmp_path / "ck", target=state)
+        _assert_bitwise(restored, _state())
+
+    def test_reshard_refuses_uncommitted_source(self, tmp_path):
+        (tmp_path / "junk").mkdir()
+        with pytest.raises(ReshardError):
+            reshard.reshard_checkpoint(
+                tmp_path / "junk", fsdp_plan(min_size=1), _mesh({"fsdp": 2}))
+
+    def test_plan_describes_schedule_and_byte_totals(self, tmp_path):
+        mesh_a = _mesh({"fsdp": 4})
+        save_checkpoint(tmp_path / "ck",
+                        _shard(_state(), fsdp_plan(min_size=1), mesh_a))
+        pl = reshard.plan_reshard(
+            tmp_path / "ck", gspmd_2d_plan(min_size=1), _mesh({"fsdp": 2, "tp": 2}))
+        names = {t.name for t in pl.leaves}
+        assert "params.dense.kernel" in names
+        assert "opt.0.mu.dense.kernel" in names  # optimizer state planned too
+        assert pl.total_bytes == sum(t.nbytes for t in pl.leaves)
+        text = pl.describe()
+        assert "params.dense.kernel" in text and "chunks" in text
+
+    def test_target_mesh_can_be_device_free_meshspec(self, tmp_path):
+        # The offline path is pure tensorstore: planning AND applying
+        # work against a MeshSpec, no accelerator runtime needed
+        # (tools/reshard_ctl.py relies on this).
+        mesh_a = _mesh({"fsdp": 4})
+        base = _state()
+        save_checkpoint(tmp_path / "src", _shard(base, fsdp_plan(min_size=1), mesh_a))
+        dst = reshard.reshard_checkpoint(
+            tmp_path / "src", fsdp_plan(min_size=1),
+            reshard.MeshSpec({"fsdp": 2}), tmp_path / "dst")
+        restored = restore_checkpoint(
+            dst, target=_shard(base, fsdp_plan(min_size=1), _mesh({"fsdp": 2})))
+        _assert_bitwise(restored, base)
+
+
+class TestMemoryBound:
+    def test_transfer_peak_bounded_by_chunk_budget(self, tmp_path):
+        # One leaf far over the budget: 1 MiB of float32 against a
+        # 16 KiB chunk budget.  The tracked host-staging peak must stay
+        # within 2x the budget (transfer stages one chunk; the bitwise
+        # verify double-buffers source + destination chunks).
+        mesh_a, mesh_b = _mesh({"fsdp": 4}), _mesh({"fsdp": 2})
+        big = {"w": jnp.arange(262144, dtype=jnp.float32).reshape(1024, 256),
+               "step": jnp.int32(0)}
+        save_checkpoint(tmp_path / "src",
+                        _shard(big, fsdp_plan(min_size=1), mesh_a))
+        chunk_mb = 16 / 1024  # 16 KiB
+        budget = int(chunk_mb * (1 << 20))
+        assert big["w"].nbytes > budget  # the leaf genuinely exceeds it
+
+        dst = reshard.reshard_checkpoint(
+            tmp_path / "src", fsdp_plan(min_size=1), mesh_b,
+            tmp_path / "dst", chunk_mb=chunk_mb)
+        peak = reshard.last_transfer_peak_bytes()
+        assert 0 < peak <= 2 * budget
+
+        restored = restore_checkpoint(
+            dst, target=_shard(big, fsdp_plan(min_size=1), mesh_b))
+        _assert_bitwise(restored, big)
+
+    def test_online_peak_respects_env_budget(self, tmp_path):
+        from torchdistx_tpu import config as tdx_config
+
+        mesh_a, mesh_b = _mesh({"fsdp": 4}), _mesh({"fsdp": 2})
+        big = {"w": jnp.arange(131072, dtype=jnp.float32).reshape(512, 256),
+               "step": jnp.int32(0)}
+        save_checkpoint(tmp_path / "src",
+                        _shard(big, fsdp_plan(min_size=1), mesh_a))
+        chunk_mb = 16 / 1024
+        budget = int(chunk_mb * (1 << 20))
+        with tdx_config.override(reshard_chunk_mb=chunk_mb):
+            out = reshard.restore_resharded(
+                tmp_path / "src", _shard(big, fsdp_plan(min_size=1), mesh_b))
+        _assert_bitwise(out, big)
+        assert 0 < reshard.last_transfer_peak_bytes() <= 2 * budget
+
+
+class TestElasticReshard:
+    def _mk(self, mesh):
+        sh = NamedSharding(mesh, P("fsdp"))
+        return {"w": jax.device_put(jnp.arange(16, dtype=jnp.float32), sh),
+                "n": jnp.float32(0.0)}
+
+    @staticmethod
+    def _step(state, batch):
+        return ({"w": state["w"] * jnp.float32(1.5) + batch,
+                 "n": state["n"] + 1}, {})
+
+    def test_needs_reshard_discriminates(self, tmp_path):
+        mesh_a, mesh_b = _mesh({"fsdp": 4}), _mesh({"fsdp": 2})
+        save_checkpoint(tmp_path / "ck", self._mk(mesh_a))
+        assert reshard.needs_reshard(tmp_path / "ck", self._mk(mesh_a)) is False
+        assert reshard.needs_reshard(tmp_path / "ck", self._mk(mesh_b)) is True
+
+    def test_resume_onto_smaller_mesh_reshards_in_flight(self, tmp_path):
+        mesh_a, mesh_b = _mesh({"fsdp": 4}), _mesh({"fsdp": 2})
+        batches = [jnp.float32(i) for i in range(1, 7)]
+        out4, steps4, _ = run_elastic(
+            self._step, self._mk(mesh_a), batches[:4],
+            checkpoint_dir=str(tmp_path), checkpoint_every=2,
+            probe_on_restart=False)
+        assert steps4 == 4
+
+        before = observe.counters().counter("tdx.reshard.elastic_reshards").value
+        out, steps, _ = run_elastic(
+            self._step, self._mk(mesh_b), batches,
+            checkpoint_dir=str(tmp_path), checkpoint_every=100,
+            resume=True, probe_on_restart=False)
+        assert steps == 6
+        assert observe.counters().counter(
+            "tdx.reshard.elastic_reshards").value == before + 1
+        # New-mesh layout...
+        assert out["w"].sharding.mesh.shape == {"fsdp": 2}
+        # ... exact trajectory: bitwise equal to the uninterrupted run.
+        ref = self._mk(mesh_a)
+        for b in batches:
+            ref, _ = self._step(ref, b)
+        assert _bits(out["w"]) == _bits(ref["w"])
+
+    def test_resume_onto_larger_mesh_reshards_in_flight(self, tmp_path):
+        mesh_a, mesh_b = _mesh({"fsdp": 2}), _mesh({"fsdp": 4})
+        batches = [jnp.float32(i) for i in range(1, 5)]
+        run_elastic(self._step, self._mk(mesh_a), batches[:2],
+                    checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                    probe_on_restart=False)
+        out, steps, _ = run_elastic(
+            self._step, self._mk(mesh_b), batches,
+            checkpoint_dir=str(tmp_path), checkpoint_every=100,
+            resume=True, probe_on_restart=False)
+        assert steps == 4
+        assert out["w"].sharding.mesh.shape == {"fsdp": 4}
+        ref = self._mk(mesh_a)
+        for b in batches:
+            ref, _ = self._step(ref, b)
+        assert _bits(out["w"]) == _bits(ref["w"])
+
+    def test_same_mesh_resume_skips_reshard(self, tmp_path):
+        mesh_a = _mesh({"fsdp": 4})
+        batches = [jnp.float32(i) for i in range(1, 4)]
+        run_elastic(self._step, self._mk(mesh_a), batches[:2],
+                    checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                    probe_on_restart=False)
+        before = observe.counters().counter("tdx.reshard.elastic_reshards").value
+        run_elastic(self._step, self._mk(mesh_a), batches,
+                    checkpoint_dir=str(tmp_path), checkpoint_every=100,
+                    resume=True, probe_on_restart=False)
+        assert observe.counters().counter(
+            "tdx.reshard.elastic_reshards").value == before
+
+
+class TestChaosReshard:
+    def _save_src(self, tmp_path, axes={"fsdp": 4}):
+        src = tmp_path / "src"
+        save_checkpoint(src, _shard(_state(), fsdp_plan(min_size=1), _mesh(axes)))
+        return src
+
+    def test_raise_fault_degrades_never_corrupts(self, tmp_path):
+        src = self._save_src(tmp_path)
+        chaos.install("reshard@2=raise")
+        try:
+            with pytest.raises(ReshardError):
+                reshard.reshard_checkpoint(
+                    src, fsdp_plan(min_size=1), _mesh({"fsdp": 2}),
+                    tmp_path / "dst")
+        finally:
+            chaos.clear()
+        ok, reason = verify_checkpoint(src)  # source untouched
+        assert ok, reason
+        assert not (tmp_path / "dst").exists()  # no partial destination
+        assert not (tmp_path / "src.corrupt").exists()  # nothing quarantined
+
+    def test_corrupt_fault_caught_by_bitwise_verify(self, tmp_path):
+        src = self._save_src(tmp_path)
+        before = observe.counters().counter("tdx.reshard.verify_fail").value
+        chaos.install("reshard@3=corrupt:flip")
+        try:
+            with pytest.raises(ReshardError, match="verify"):
+                reshard.reshard_checkpoint(
+                    src, fsdp_plan(min_size=1), _mesh({"fsdp": 2}),
+                    tmp_path / "dst")
+        finally:
+            chaos.clear()
+        assert observe.counters().counter(
+            "tdx.reshard.verify_fail").value == before + 1
+        ok, reason = verify_checkpoint(src)
+        assert ok, reason
+        assert not (tmp_path / "dst").exists()
+
+    def test_slow_fault_completes_exactly(self, tmp_path):
+        src = self._save_src(tmp_path)
+        base = _state()
+        chaos.install("reshard@1=slow:0.01")
+        try:
+            dst = reshard.reshard_checkpoint(
+                src, fsdp_plan(min_size=1), _mesh({"fsdp": 2}), tmp_path / "dst")
+        finally:
+            chaos.clear()
+        restored = restore_checkpoint(
+            dst, target=_shard(base, fsdp_plan(min_size=1), _mesh({"fsdp": 2})))
+        _assert_bitwise(restored, base)
+
+    def test_online_corrupt_detected_and_typed(self, tmp_path):
+        src = self._save_src(tmp_path)
+        plan = chaos.parse_plan("reshard@2=corrupt:flip")
+        with pytest.raises(ReshardError):
+            reshard.restore_resharded(
+                src, _shard(_state(), fsdp_plan(min_size=1), _mesh({"fsdp": 2})),
+                chaos_plan=plan)
+        ok, reason = verify_checkpoint(src)
+        assert ok, reason
+
+    def test_elastic_reshard_failure_does_not_quarantine(self, tmp_path):
+        """A ReshardError inside _restore_best must surface typed — not
+        be swallowed by the quarantine fallback (the source checkpoint
+        is fine; it is the TRANSFER that failed)."""
+        mesh_a, mesh_b = _mesh({"fsdp": 4}), _mesh({"fsdp": 2})
+        sh = NamedSharding(mesh_a, P("fsdp"))
+        state = {"w": jax.device_put(jnp.arange(16, dtype=jnp.float32), sh)}
+        run_elastic(lambda s, b: ({"w": s["w"] + b}, {}), state,
+                    [jnp.float32(1.0)], checkpoint_dir=str(tmp_path),
+                    checkpoint_every=1, probe_on_restart=False)
+        chaos.install("reshard@1=raise")
+        try:
+            with pytest.raises(ReshardError):
+                run_elastic(
+                    lambda s, b: ({"w": s["w"] + b}, {}),
+                    {"w": jax.device_put(jnp.arange(16, dtype=jnp.float32),
+                                         NamedSharding(mesh_b, P("fsdp")))},
+                    [jnp.float32(1.0)], checkpoint_dir=str(tmp_path),
+                    checkpoint_every=100, resume=True, probe_on_restart=False)
+        finally:
+            chaos.clear()
+        ok, reason = verify_checkpoint(tmp_path / "step_1")
+        assert ok, reason  # the good checkpoint was NOT quarantined
+
+
+class TestAutoExecutor:
+    def test_explicit_spellings_unchanged(self):
+        from torchdistx_tpu.parallel import pipeline
+        assert pipeline._resolve_executor("segmented", total_ticks=4) == "segmented"
+        assert pipeline._resolve_executor("uniform", total_ticks=400) == "uniform"
+        with pytest.raises(ValueError, match="bogus"):
+            pipeline._resolve_executor("bogus")
+
+    def test_auto_picks_by_schedule_and_host_size(self, monkeypatch):
+        from torchdistx_tpu.parallel import pipeline
+        monkeypatch.setattr(pipeline.os, "cpu_count", lambda: 8)
+        assert pipeline._resolve_executor("auto", total_ticks=8) == "uniform"
+        assert pipeline._resolve_executor("auto", total_ticks=64) == "segmented"
+        monkeypatch.setattr(pipeline.os, "cpu_count", lambda: 64)
+        # A big host amortizes segmented compile even on tiny schedules.
+        assert pipeline._resolve_executor("auto", total_ticks=8) == "segmented"
+
+    def test_env_spelling_routes_through_auto(self, monkeypatch):
+        from torchdistx_tpu.parallel import pipeline
+        monkeypatch.setenv("TDX_PP_EXECUTOR", "auto")
+        monkeypatch.setattr(pipeline.os, "cpu_count", lambda: 4)
+        assert pipeline._resolve_executor(None, total_ticks=6) == "uniform"
+
+
+_SHRINK_PHASE1 = """
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from torchdistx_tpu.parallel.mesh import make_mesh
+from torchdistx_tpu.utils.failures import run_elastic
+
+d = sys.argv[1]
+mesh = make_mesh({"fsdp": 4}, devices=jax.devices()[:4])
+state = {"w": jax.device_put(jnp.arange(32, dtype=jnp.float32),
+                             NamedSharding(mesh, P("fsdp")))}
+
+def stepf(state, batch):
+    time.sleep(0.1)
+    return {"w": state["w"] * jnp.float32(1.25) + batch}, {}
+
+batches = [jnp.float32(i) for i in range(1, 41)]
+with open(os.path.join(d, "started"), "w") as f:
+    f.write("1")
+run_elastic(stepf, state, batches, checkpoint_dir=d, checkpoint_every=2,
+            exit_on_drain=True)
+print("RAN-TO-COMPLETION")
+"""
+
+_SHRINK_PHASE2 = """
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from torchdistx_tpu import reshard
+from torchdistx_tpu.parallel.mesh import make_mesh
+from torchdistx_tpu.parallel.sharding import fsdp_plan
+from torchdistx_tpu.utils.checkpoint import restore_checkpoint
+from torchdistx_tpu.utils.failures import run_elastic
+
+d, total = sys.argv[1], int(sys.argv[2])
+mesh = make_mesh({"fsdp": 2}, devices=jax.devices()[:2])
+sh = NamedSharding(mesh, P("fsdp"))
+mk = lambda: {"w": jax.device_put(jnp.arange(32, dtype=jnp.float32), sh)}
+
+def stepf(state, batch):
+    return {"w": state["w"] * jnp.float32(1.25) + batch}, {}
+
+batches = [jnp.float32(i) for i in range(1, total + 1)]
+out, steps, _ = run_elastic(stepf, mk(), batches, checkpoint_dir=d,
+                            checkpoint_every=1000, resume=True,
+                            probe_on_restart=False)
+assert steps == total, (steps, total)
+
+# Reference trajectory: offline-reshard the drained checkpoint to the
+# 2-way layout, restore it plainly, and run the remaining steps without
+# the elastic loop.
+drained = json.load(open(os.path.join(d, "CLEAN_EXIT.json")))["step"]
+src = os.path.join(d, "step_%d" % drained)
+dst = reshard.reshard_checkpoint(src, fsdp_plan(min_size=1), mesh)
+ref = restore_checkpoint(str(dst), target=mk())
+for b in batches[drained:]:
+    ref, _ = stepf(ref, b)
+rb = np.asarray(ref["w"]).view(np.uint8).tobytes()
+ob = np.asarray(out["w"]).view(np.uint8).tobytes()
+assert rb == ob, "elastic-resharded trajectory diverged from reference"
+print("TRAJECTORY-BITWISE-EQUAL steps=%d drained=%d" % (steps, drained))
+"""
+
+
+@pytest.mark.slow
+class TestMeshShrinkMidTraining:
+    """The ISSUE's chaos scenario: SIGTERM-drain a 4-way run, restore the
+    drain checkpoint onto a 2-way mesh via the elastic reshard path in a
+    FRESH process, and pin the continued trajectory bitwise against an
+    uninterrupted 2-way run from the resharded state."""
+
+    def test_sigterm_drain_then_resume_on_half_mesh(self, tmp_path):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+        s1 = tmp_path / "phase1.py"
+        s1.write_text(_SHRINK_PHASE1)
+        proc = subprocess.Popen(
+            [sys.executable, str(s1), str(tmp_path)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.time() + 120
+            started = tmp_path / "started"
+            while not started.exists():
+                assert proc.poll() is None, proc.communicate()[1]
+                assert time.time() < deadline, "phase 1 never reached the loop"
+                time.sleep(0.05)
+            time.sleep(0.5)  # a few steps in
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=90)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 0, err
+        assert "RAN-TO-COMPLETION" not in out
+        drained = json.loads((tmp_path / "CLEAN_EXIT.json").read_text())["step"]
+        assert 1 <= drained < 40
+
+        s2 = tmp_path / "phase2.py"
+        s2.write_text(_SHRINK_PHASE2)
+        res = subprocess.run(
+            [sys.executable, str(s2), str(tmp_path), "40"], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr
+        assert "TRAJECTORY-BITWISE-EQUAL" in res.stdout

@@ -57,6 +57,18 @@ batching, paging, preemption, and faults must never change a token:
     python tools/soak.py --modes serve --seconds 300 \\
         --fault-plan 'serve@2=raise;serve@5=slow:0.1'
 
+The ``reshard`` mode soaks the topology-migrating checkpoint
+redistributor (docs/robustness.md §Resharding): each seed saves a
+randomized state, rechunk-copies it through a randomized pair of
+(mesh, sharding-plan) topologies with a randomized chunk budget, and
+asserts the final restore is bitwise-equal to the original; half the
+seeds inject a ``reshard``-site fault plan and assert
+degrade-never-corrupt instead (typed ``ReshardError``, source intact,
+no destination left behind):
+
+    python tools/soak.py --modes reshard --seconds 300 \\
+        --fault-plan 'reshard@2=corrupt:flip'
+
 Failures are appended to ``tools/soak_failures.jsonl`` (seed + mode +
 exception) and the exit code is non-zero if any occurred.
 """
@@ -75,7 +87,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
          "geom", "geom_single", "geom_bridge", "elastic", "materialize",
-         "registry", "serve")
+         "registry", "serve", "reshard")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -274,6 +286,110 @@ def _materialize_oracle(seed: int, plan_text: "str | None"):
         mat._reset_cache_binding()
         shutil.rmtree(cache_dir, ignore_errors=True)
         shutil.rmtree(resume_dir, ignore_errors=True)
+    return None
+
+
+def _reshard_oracle(seed: int, plan_text: "str | None"):
+    """One randomized plan-pair reshard: save a seeded state, rechunk it
+    through two random (mesh, plan) topologies, and assert the final
+    restore is bitwise-equal to the original — params and optimizer-like
+    leaves, bf16 included.  Half the seeds additionally inject a
+    ``reshard``-site fault (raise / slow / corrupt) and then assert the
+    degrade-never-corrupt contract instead: typed ``ReshardError``, the
+    source still verifies, no committed destination left behind.
+
+    The whole oracle is device-free (offline resharding is pure
+    tensorstore I/O against :class:`~torchdistx_tpu.reshard.MeshSpec`
+    targets), so it soaks in a plain single-device CPU worker."""
+    import random
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchdistx_tpu import chaos, reshard
+    from torchdistx_tpu.parallel.sharding import (
+        ShardingPlan, fsdp_plan, gspmd_2d_plan,
+    )
+    from torchdistx_tpu.utils.checkpoint import (
+        restore_checkpoint, save_checkpoint, verify_checkpoint,
+    )
+
+    rng = random.Random(seed)
+
+    def rand_mesh_plan():
+        kind = rng.choice(["replicated", "fsdp", "gspmd2d"])
+        if kind == "replicated":
+            return reshard.MeshSpec({"fsdp": rng.choice([2, 4])}), ShardingPlan()
+        if kind == "fsdp":
+            return (reshard.MeshSpec({"fsdp": rng.choice([2, 4, 8])}),
+                    fsdp_plan(min_size=1))
+        return (reshard.MeshSpec({"fsdp": rng.choice([2, 4]),
+                                  "tp": rng.choice([2, 4])}),
+                gspmd_2d_plan(min_size=1))
+
+    # Seeded leaves: dims are multiples of 8 so every mesh size divides.
+    def rand_leaf():
+        dt = rng.choice([jnp.float32, jnp.bfloat16, jnp.int32])
+        shape = tuple(8 * rng.randrange(1, 4)
+                      for _ in range(rng.randrange(1, 3)))
+        n = int(np.prod(shape))
+        return jnp.asarray(
+            np.random.RandomState(seed ^ n).randn(*shape) * 100, dtype=dt)
+
+    state = {"leaf_%d" % i: rand_leaf() for i in range(rng.randrange(2, 5))}
+    state["step"] = jnp.int32(rng.randrange(100))
+    mesh_a, plan_a = rand_mesh_plan()
+    mesh_b, plan_b = rand_mesh_plan()
+    chunk_mb = rng.choice([0.0005, 0.002, 0.01, None])
+
+    if plan_text:
+        fault = plan_text
+    elif rng.random() < 0.5:
+        kind = rng.choice(["raise", "slow", "corrupt"])
+        arg = {"raise": "", "slow": ":0.02", "corrupt": ":flip"}[kind]
+        fault = f"reshard@{rng.randrange(1, 6)}={kind}{arg}"
+    else:
+        fault = None
+
+    d = Path(tempfile.mkdtemp(prefix="tdx_soak_reshard_"))
+    try:
+        save_checkpoint(d / "src", state)
+        # Leg 1 (fault-free) lays the checkpoint out under plan A so leg
+        # 2 migrates a genuinely sharded chunk grid.
+        a = reshard.reshard_checkpoint(d / "src", plan_a, mesh_a, d / "a")
+        try:
+            chaos.install(fault)
+            b = reshard.reshard_checkpoint(a, plan_b, mesh_b, d / "b",
+                                           chunk_mb=chunk_mb)
+        except reshard.ReshardError:
+            if fault is None:
+                raise
+            # Degrade-never-corrupt: source intact, destination gone.
+            ok, reason = verify_checkpoint(a)
+            if not ok:
+                return ("mismatch", f"source damaged after failed "
+                                    f"reshard ({fault}): {reason}")
+            if (d / "b").exists():
+                return ("mismatch",
+                        f"failed reshard left a destination ({fault})")
+            return None
+        finally:
+            chaos.clear()
+        out = restore_checkpoint(b, target=jax.tree_util.tree_map(
+            lambda x: jnp.zeros_like(x), state))
+        for k in state:
+            want = np.asarray(state[k]).reshape(-1).view(np.uint8)
+            got = np.asarray(out[k]).reshape(-1).view(np.uint8)
+            if not np.array_equal(want, got):
+                return ("mismatch",
+                        f"{k} differs after {mesh_a}->{mesh_b} "
+                        f"(chunk_mb={chunk_mb}, fault={fault})")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     return None
 
 
@@ -508,6 +624,10 @@ def _run_seed(mode: str, seed: int):
             r = _serve_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
+        elif mode == "reshard":
+            r = _reshard_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "serialize":
             import tempfile
             from pathlib import Path
@@ -547,9 +667,9 @@ def main() -> int:
                                                   "soak_failures.jsonl"))
     ap.add_argument("--fault-plan", default=None,
                     help="chaos plan for --modes elastic/materialize/"
-                         "registry (grammar: torchdistx_tpu.chaos / "
-                         "docs/robustness.md); default: a seeded-random "
-                         "plan per seed")
+                         "registry/serve/reshard (grammar: "
+                         "torchdistx_tpu.chaos / docs/robustness.md); "
+                         "default: a seeded-random plan per seed")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",
                     help="jax backend for elastic-only soaks: 'default' "
                          "soaks recovery on the real accelerator "

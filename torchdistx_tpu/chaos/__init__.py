@@ -32,7 +32,9 @@ Fault kinds and what they model:
              on; at the materialization sites (``lower`` / ``compile`` /
              ``execute`` / ``cache``) it damages the persistent XLA
              compile-cache entries on disk instead (the poisoned-cache
-             model)
+             model); at the ``reshard`` site it bit-flips the engine's
+             in-flight transfer chunk buffer (the torn-DMA model — no
+             file is touched; the reshard verify stage catches it)
 ``slow``     a save that takes extra seconds — checkpoint latency
              hiding the preemption deadline
 ``preempt``  SIGTERM to self — the *announced* preemption notice
@@ -46,7 +48,11 @@ monolithic engine is group 1); see docs/robustness.md.  The
 fetch and publish operations (:mod:`torchdistx_tpu.registry`), same
 group-number keying; ``corrupt`` there damages the published artifacts
 (:func:`corrupt_registry_dir`) so the CRC self-verification and
-quarantine path is exercised for real.
+quarantine path is exercised for real.  The ``reshard`` site fires once
+per transfer chunk inside :mod:`torchdistx_tpu.reshard` (1-based chunk
+number): a failed reshard quarantines nothing and leaves the source
+checkpoint untouched — it surfaces as a typed ``ReshardError``
+(docs/robustness.md §Resharding).
 """
 
 from __future__ import annotations

@@ -109,6 +109,13 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
         os.kill(os.getpid(), signal.SIGTERM)
         return
     if fault.kind == "corrupt":
+        if fault.site == "reshard":
+            # The reshard engine damages its OWN in-flight chunk buffer
+            # when it sees this fault fire (degrade-never-corrupt: the
+            # bitwise verify stage catches it, the destination stays
+            # uncommitted, and no file — least of all the source
+            # checkpoint — is ever touched).  Nothing to do here.
+            return
         if path is None:
             raise ValueError(
                 f"corrupt fault needs a target path (checkpoint dir, the "

@@ -15,6 +15,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
            | 'lower' | 'compile' | 'execute' | 'cache'  (materialization)
            | 'registry'                             (artifact registry)
            | 'serve'                                (serving engine)
+           | 'reshard'                              (checkpoint reshard)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
 
 Examples::
@@ -32,6 +33,9 @@ Examples::
     serve@3=raise                # replica fault at engine step 3: every
                                  # active request is requeued and
                                  # regenerated (recompute preemption)
+    reshard@2=corrupt:flip       # bit-flip the 2nd in-flight transfer
+                                 # chunk of a checkpoint reshard (caught
+                                 # by the bitwise verify stage)
 
 Each entry fires ``count`` times (default 1) and is then spent — a
 restarted step re-executes fault-free, which is what makes
@@ -50,7 +54,12 @@ so an injected registry fault costs savings, never correctness).  The
 step number; kinds ``raise`` / ``slow``): a raised fault mid-batch
 requeues every active request, which greedy decode then regenerates
 identically — a replica fault costs latency, never a wrong token
-(docs/serving.md).
+(docs/serving.md).  The ``reshard`` site fires once per transfer chunk
+of a checkpoint redistribution (1-based chunk number; kinds ``raise`` /
+``slow`` / ``corrupt``): ``corrupt`` damages the engine's in-flight
+chunk buffer — never any file — so the reshard verify stage catches it,
+the destination stays uncommitted, and the SOURCE checkpoint is left
+untouched (degrade-never-corrupt; docs/robustness.md §Resharding).
 """
 
 from __future__ import annotations
@@ -61,7 +70,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
-         "registry", "serve")
+         "registry", "serve", "reshard")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
 
 _ENTRY_RE = re.compile(

@@ -90,6 +90,14 @@ Environment variables (read at first import):
                         transfers (resume loads fall back to one
                         ``jax.device_put`` per array — the pre-transport
                         behavior, kept as an escape hatch / A-B knob).
+``TDX_RESHARD_CHUNK_MB``
+                        Host-memory budget (MiB, default 64) for one
+                        transfer chunk in :mod:`torchdistx_tpu.reshard`:
+                        checkpoint redistribution streams leaf-by-leaf and
+                        splits any leaf whose per-shard slice exceeds this
+                        budget into bounded slab reads, so resharding never
+                        materializes a full unsharded leaf on one host (see
+                        docs/robustness.md §Resharding).
 ``TDX_LOG_LEVEL``       Logging level name for the framework logger.
 ``TDX_TRACE_DIR``       Directory for runtime telemetry traces: when set,
                         :mod:`torchdistx_tpu.observe` collects spans across
@@ -193,6 +201,7 @@ class Config:
     materialize_donate: bool = True
     materialize_init_dtype: Optional[str] = None
     materialize_batch_put: bool = True
+    reshard_chunk_mb: float = 64.0
 
 
 def _from_env() -> Config:
@@ -231,6 +240,7 @@ def _from_env() -> Config:
         materialize_batch_put=(
             os.environ.get("TDX_MATERIALIZE_BATCH_PUT", "1") != "0"
         ),
+        reshard_chunk_mb=float(os.environ.get("TDX_RESHARD_CHUNK_MB", "64")),
     )
 
 

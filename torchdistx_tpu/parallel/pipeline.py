@@ -56,17 +56,42 @@ from .collectives import ring_next, ring_prev, send_next, send_prev
 #   segmented executor must reproduce its five outputs exactly —
 #   tests/test_parallel.py, tests/test_interleave.py) and as the bench
 #   A/B (`bench.py --phase schedule_measured`).
-_EXECUTORS = ("segmented", "uniform")
+# * ``"auto"`` — resolves to one of the above per schedule: the
+#   segmented executor's win is amortizing per-tick dispatch over long
+#   steady runs, but for tiny schedules on small hosts its extra
+#   fori_loop bodies cost more compile time than they save at runtime,
+#   so ``auto`` keeps ``uniform`` there and picks ``segmented``
+#   everywhere else.  The decision is emitted as a ``pp.executor_auto``
+#   span so a trace shows which executor actually ran.
+_EXECUTORS = ("segmented", "uniform", "auto")
+# "tiny schedule on a small host" thresholds for the auto pick: at or
+# under _AUTO_TINY_TICKS total ticks AND at or under _AUTO_SMALL_CORES
+# host cores the segmented executor has nothing to amortize.
+_AUTO_TINY_TICKS = 12
+_AUTO_SMALL_CORES = 8
 
 
-def _resolve_executor(executor: Optional[str]) -> str:
+def _resolve_executor(
+    executor: Optional[str], *, total_ticks: Optional[int] = None
+) -> str:
     ex = executor or os.environ.get("TDX_PP_EXECUTOR", "segmented")
     if ex not in _EXECUTORS:
         raise ValueError(
             f"pipeline executor must be one of {_EXECUTORS}, got {ex!r} "
             f"(TDX_PP_EXECUTOR overrides the default)"
         )
-    return ex
+    if ex != "auto":
+        return ex
+    ticks = int(total_ticks) if total_ticks is not None else 0
+    cores = os.cpu_count() or 1
+    picked = (
+        "uniform"
+        if ticks <= _AUTO_TINY_TICKS and cores <= _AUTO_SMALL_CORES
+        else "segmented"
+    )
+    with observe.span("pp.executor_auto", category="pp") as sp:
+        sp.set(picked=picked, total_ticks=ticks, host_cores=cores)
+    return picked
 
 
 def _note_schedule_segments(segs, label: str) -> None:
@@ -434,7 +459,6 @@ def pipeline_train_1f1b(
     """
     from .interleave import flat_1f1b_segments
 
-    executor = _resolve_executor(executor)
     su = _FusedSetup(cfg, params, tokens, decomp, n_microbatches,
                      attn_fn, segment_ids)
     n_mb = su.n_mb
@@ -442,6 +466,9 @@ def pipeline_train_1f1b(
     x_mb, tok_mb, seg_mb, has_segs = su.x_mb, su.tok_mb, su.seg_mb, su.has_segs
     pp = mesh.shape[axis_name]
     flat_segs = flat_1f1b_segments(pp, n_mb)
+    # Resolved AFTER the schedule size is known so "auto" can size its
+    # pick to this schedule's actual tick count.
+    executor = _resolve_executor(executor, total_ticks=2 * (pp - 1) + n_mb)
     if executor == "segmented":
         _note_schedule_segments(flat_segs, "1f1b")
 
@@ -679,7 +706,6 @@ def pipeline_train_interleaved(
     """
     from .interleave import interleaved_schedule
 
-    executor = _resolve_executor(executor)
     su = _FusedSetup(cfg, params, tokens, decomp, n_microbatches,
                      attn_fn, segment_ids)
     n_mb = su.n_mb
@@ -690,6 +716,11 @@ def pipeline_train_interleaved(
     sched = interleaved_schedule(pp, v, n_mb)
     tbl = {k: jnp.asarray(a) for k, a in sched.tables().items()}
     sched_segs = sched.segments()
+    # Resolved AFTER the schedule is built so "auto" can size its pick
+    # to this schedule's actual tick count.
+    executor = _resolve_executor(
+        executor, total_ticks=sum(s.ticks for s in sched_segs)
+    )
     if executor == "segmented":
         _note_schedule_segments(sched_segs, "interleaved")
     perm, inv = _interleave_perm(cfg.n_layers, pp, v)

@@ -144,6 +144,53 @@ def _validate_spec(name, shape, spec: PartitionSpec, mesh: Mesh) -> PartitionSpe
     return PartitionSpec(*new_axes) if changed else spec
 
 
+# -- spec serialization ----------------------------------------------------
+#
+# Checkpoint manifests record each leaf's layout as a string (the
+# "topology block", docs/robustness.md §Resharding); the reshard differ
+# parses them back.  The format is the PartitionSpec constructor's own
+# argument tuple — ``repr``-stable, ``ast.literal_eval``-parseable, and
+# human-readable in the manifest JSON.
+
+
+def spec_str(spec: Optional[PartitionSpec]) -> str:
+    """Serialize a PartitionSpec: ``P('fsdp', None)`` → ``"('fsdp', None)"``,
+    ``P()``/``None`` → ``"()"``."""
+    if spec is None:
+        return "()"
+    dims = []
+    for axis in spec:
+        if isinstance(axis, (tuple, list)):
+            dims.append(tuple(str(a) for a in axis))
+        else:
+            dims.append(None if axis is None else str(axis))
+    return repr(tuple(dims))
+
+
+def parse_spec_str(s: str) -> PartitionSpec:
+    """Inverse of :func:`spec_str` (tolerates surrounding whitespace)."""
+    import ast
+
+    val = ast.literal_eval(s.strip())
+    if not isinstance(val, tuple):
+        raise ValueError(f"not a PartitionSpec string: {s!r}")
+    return PartitionSpec(*val)
+
+
+def plan_digest(mesh_axes: Dict[str, int], specs: Dict[str, str]) -> str:
+    """Stable digest of a concrete layout: mesh axis sizes + every leaf's
+    spec string.  Equal digests ⇒ a checkpoint needs no resharding to load
+    under the other topology (recorded in the manifest topology block and
+    compared by the elastic restore path)."""
+    import json
+    import zlib
+
+    payload = json.dumps(
+        {"mesh": dict(mesh_axes), "specs": dict(specs)}, sort_keys=True
+    ).encode()
+    return f"{zlib.crc32(payload):08x}"
+
+
 # -- stock plans -----------------------------------------------------------
 
 

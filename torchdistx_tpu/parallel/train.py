@@ -84,9 +84,11 @@ def make_train_step(
 
     ``pipeline_executor`` selects the fused schedules' loop structure
     (``"segmented"`` phase-specialized default / ``"uniform"`` parity
-    baseline — docs/performance.md §The schedule executor); ``None``
-    follows ``TDX_PP_EXECUTOR``.  Both are bitwise-equal; the knob
-    exists for the bench A/B and parity tests.
+    baseline / ``"auto"``, which keeps ``uniform`` for tiny schedules on
+    small hosts and ``segmented`` otherwise, logging the pick as a
+    ``pp.executor_auto`` span — docs/performance.md §The schedule
+    executor); ``None`` follows ``TDX_PP_EXECUTOR``.  All spellings are
+    bitwise-equal; the knob exists for the bench A/B and parity tests.
     """
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     baxes = tuple(a for a in batch_axes if a in mesh.axis_names)

@@ -23,6 +23,13 @@ bare orbax layout cannot:
 * a bad checkpoint is :func:`quarantine_checkpoint`-renamed to
   ``<dir>.corrupt`` — kept for forensics, invisible to resume scans.
 
+Since round 13 the manifest also records a **topology block** — the mesh
+axis names/sizes, each leaf's PartitionSpec string, and a plan digest
+(:func:`state_topology`) — so a restore can detect that the checkpoint
+was written under a different ``ShardingPlan``/mesh and route through
+:mod:`torchdistx_tpu.reshard` instead of crashing.  Old manifests
+without the block still verify: the reader is schema-tolerant.
+
 Verification telemetry: ``ckpt.save`` / ``ckpt.restore`` / ``ckpt.verify``
 spans, ``tdx.ckpt.verify_fail`` / ``tdx.ckpt.quarantined`` counters
 (see docs/robustness.md for the full vocabulary).
@@ -55,9 +62,12 @@ __all__ = [
     "AsyncCheckpointSaver",
     "CheckpointCorruptError",
     "iter_payload_files",
+    "leaf_storage_name",
     "quarantine_checkpoint",
+    "read_manifest",
     "restore_checkpoint",
     "save_checkpoint",
+    "state_topology",
     "verify_checkpoint",
     "write_manifest",
 ]
@@ -113,25 +123,89 @@ def _leaf_tree(state: Any) -> List[dict]:
     return out
 
 
+def leaf_storage_name(keypath) -> str:
+    """The orbax/tensorstore storage name of a leaf: keypath components
+    joined with ``.`` (dict keys and namedtuple fields by name, sequence
+    positions by index) — ``['opt'][0].mu['dense']['kernel']`` stores as
+    ``opt.0.mu.dense.kernel``.  This is the key the reshard engine uses
+    to address individual leaves inside the checkpoint's OCDBT kvstore,
+    and the key of the manifest topology block's per-leaf spec table."""
+    parts = []
+    for k in keypath:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return ".".join(parts)
+
+
+def state_topology(state: Any) -> Optional[dict]:
+    """The manifest ``topology`` block for a pytree of (possibly sharded)
+    arrays: mesh axis names/sizes, per-leaf PartitionSpec string (keyed by
+    storage name), and a plan digest over both.  ``None`` when the tree
+    has no array leaves.  Leaves without a ``NamedSharding`` (host scalars,
+    single-device arrays) record as replicated — ``"()"``."""
+    from ..parallel.sharding import plan_digest, spec_str  # lazy: no cycle
+
+    mesh_axes: dict = {}
+    specs: dict = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if not hasattr(leaf, "shape"):
+            continue
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            m = sh.mesh
+            mesh_axes = {
+                str(a): int(s) for a, s in zip(m.axis_names, m.devices.shape)
+            }
+            specs[leaf_storage_name(keypath)] = spec_str(sh.spec)
+        else:
+            specs[leaf_storage_name(keypath)] = spec_str(None)
+    if not specs:
+        return None
+    return {
+        "mesh_axes": mesh_axes,
+        "specs": specs,
+        "plan_digest": plan_digest(mesh_axes, specs),
+    }
+
+
 def write_manifest(
-    path: "str | Path", state: Any = None, *, tree: Optional[List[dict]] = None
+    path: "str | Path",
+    state: Any = None,
+    *,
+    tree: Optional[List[dict]] = None,
+    topology: Optional[dict] = None,
 ) -> dict:
     """Checksum the payload, write ``tdx_manifest.json``, then commit by
     writing ``TDX_COMMITTED`` (containing the manifest's CRC32) LAST —
     marker presence therefore implies the manifest, and the manifest
-    implies every payload byte it lists.  The leaf tree comes from
-    ``state``, or precomputed via ``tree`` (async savers stash it at
-    save time instead of pinning arrays).  Returns the manifest dict."""
+    implies every payload byte it lists.  The leaf tree and topology
+    block come from ``state``, or precomputed via ``tree`` / ``topology``
+    (async savers stash them at save time instead of pinning arrays).
+    Old manifests without a topology block stay valid — verification
+    ignores keys it does not know.  Returns the manifest dict."""
     path = Path(path)
     files = {}
     for rel in sorted(iter_payload_files(path)):
         size, crc = _crc32_file(path / rel)
         files[rel] = {"size": size, "crc32": f"{crc:08x}"}
     manifest = {"version": 1, "files": files}
-    if tree is None and state is not None:
-        tree = _leaf_tree(state)
+    if state is not None:
+        if tree is None:
+            tree = _leaf_tree(state)
+        if topology is None:
+            topology = state_topology(state)
     if tree is not None:
         manifest["tree"] = tree
+    if topology is not None:
+        manifest["topology"] = topology
     payload = json.dumps(manifest, indent=1, sort_keys=True).encode()
     tmp = path / (MANIFEST_NAME + ".tmp")
     with open(tmp, "wb") as f:
@@ -149,6 +223,17 @@ def write_manifest(
 def is_committed(path: "str | Path") -> bool:
     """Cheap commit check: marker file present (no payload verification)."""
     return (Path(path) / COMMIT_MARKER).is_file()
+
+
+def read_manifest(path: "str | Path") -> Optional[dict]:
+    """The parsed ``tdx_manifest.json`` of a checkpoint, or ``None`` when
+    there is no (readable) manifest — pre-manifest checkpoints restore
+    fine, they just carry no integrity or topology metadata."""
+    mf = Path(path) / MANIFEST_NAME
+    try:
+        return json.loads(mf.read_bytes())
+    except (OSError, ValueError):
+        return None
 
 
 def verify_checkpoint(path: "str | Path") -> Tuple[bool, str]:
@@ -257,22 +342,23 @@ class AsyncCheckpointSaver:
         _require_orbax()
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         self._manifest = manifest
-        # (path, leaf tree) saved by orbax but not yet committed.  The
-        # tree is captured at save time — cheap metadata, no array refs.
-        self._pending: List[Tuple[Path, List[dict]]] = []
+        # (path, leaf tree, topology) saved by orbax but not yet
+        # committed.  Both are captured at save time — cheap metadata
+        # (shapes + sharding specs), no array refs.
+        self._pending: List[Tuple[Path, List[dict], Optional[dict]]] = []
 
     def save(self, path: "str | Path", state: Any, *, force: bool = True) -> None:
         path = Path(path).absolute()
         self._ckptr.save(path, args=ocp.args.StandardSave(state), force=force)
         if self._manifest:
-            self._pending.append((path, _leaf_tree(state)))
+            self._pending.append((path, _leaf_tree(state), state_topology(state)))
 
     def wait_until_finished(self) -> None:
         self._ckptr.wait_until_finished()
         pending, self._pending = self._pending, []
-        for path, tree in pending:
+        for path, tree, topology in pending:
             if path.is_dir():  # a force-overwrite may have replaced it
-                write_manifest(path, tree=tree)
+                write_manifest(path, tree=tree, topology=topology)
 
     def close(self) -> None:
         self._ckptr.close()

@@ -428,6 +428,7 @@ def run_elastic(
     # step site fires on watchdog worker threads.
     fault_plan = chaos.active_plan()
 
+    from ..reshard import ReshardError, needs_reshard, restore_resharded
     from .checkpoint import (
         is_committed,
         quarantine_checkpoint,
@@ -543,7 +544,28 @@ def run_elastic(
                 # injected restore failure must fall back like a real one,
                 # not crash the recovery path it exists to exercise.
                 chaos.maybe_inject("restore", s, path=path, plan=fault_plan)
+                if needs_reshard(path, state):
+                    # Checkpoint was written under a different topology
+                    # (mesh shape / axis names / sharding plan) than the
+                    # relaunch state: stream it through the reshard engine
+                    # instead of crashing on a sharding mismatch.
+                    observe.counter("tdx.reshard.elastic_reshards").inc()
+                    observe.instant(
+                        "reshard.elastic", category="reshard", path=path,
+                    )
+                    log.warning(
+                        "run_elastic: checkpoint %s topology differs from "
+                        "the relaunch mesh; resharding in-flight", path,
+                    )
+                    return s, restore_resharded(
+                        path, target=state, chaos_plan=fault_plan
+                    )
                 return s, restore_checkpoint(path, target=state)
+            except ReshardError:
+                # Degrade-never-corrupt: a failed reshard proves nothing
+                # about the SOURCE checkpoint (it verified clean above),
+                # so it must not be quarantined.  Surface the typed error.
+                raise
             except Exception as e:  # noqa: BLE001 — torn write below manifest
                 log.error(
                     "run_elastic: restore of verified checkpoint %s raised "
@@ -688,6 +710,12 @@ def run_elastic(
         if resume and _on_disk_steps():
             try:
                 resumed_from, state = _restore_best(verify_window=False)
+            except ReshardError:
+                # A typed reshard failure is NOT "no checkpoint": the
+                # source verified clean and only the topology migration
+                # failed.  Starting fresh would silently discard a
+                # perfectly good checkpoint — surface it instead.
+                raise
             except RuntimeError:
                 # Every candidate failed verification and is quarantined.
                 # A crash here would only delay the inevitable: the next
