@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke obs-smoke reshard-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -47,10 +47,10 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke serve-smoke obs-smoke reshard-smoke
+chaos-test: registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
-	    tests/test_registry.py tests/test_serve.py \
+	    tests/test_registry.py tests/test_serve.py tests/test_fleet.py \
 	    tests/test_flightrec.py tests/test_materialize_transport.py \
 	    tests/test_live_ops.py tests/test_bench_trend.py \
 	    tests/test_reshard.py \
@@ -73,6 +73,14 @@ obs-smoke:
 # CPU, bounded; part of `make chaos-test`.
 serve-smoke:
 	timeout -k 10 420 bash scripts/serve_smoke.sh
+
+# Fleet smoke (docs/serving.md §Fleet): registry-warm 2-replica fleet
+# bring-up with ZERO local compiles asserted, one replica chaos-killed
+# mid-storm with every response still equal to the unbatched oracle,
+# then a warm mid-run scale-up and a drain-based scale-down.  CPU,
+# bounded; part of `make chaos-test`.
+fleet-smoke:
+	timeout -k 10 420 bash scripts/fleet_smoke.sh
 
 # Pod-scale registry smoke (docs/registry.md): a 2-process sharded warm
 # against a shared artifact registry — disjoint compile shards verified

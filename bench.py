@@ -1487,6 +1487,209 @@ def phase_serving() -> dict:
     return out
 
 
+def phase_serving_fleet() -> dict:
+    """Fleet-serving phase (docs/serving.md §Fleet): the autoscaling
+    story measured end to end.  A COLD single-replica bring-up (every
+    program XLA-compiled) is the scale-up latency a fleet WITHOUT the
+    registry would pay; a registry-warm mid-run ``ServeFleet.scale_up``
+    (fresh local cache, every program fetched) is what ours pays —
+    ``fleet_scaleup_warm_speedup`` is their ratio.  Then a fixed request
+    storm is replayed through the router at 1 → 2 → 4 replicas
+    (autoscale pinned off so the replica count is the only variable) for
+    decode tokens/s; ``fleet_scaling_efficiency_2r`` = tps@2 / tps@1.
+
+    Gates (raise ⇒ CI fails, not just a slow number): every storm
+    response equals the unbatched no-cache oracle — including one more
+    2-replica storm with a chaos kill (``fleet@2=raise``) mid-batch
+    where the router must requeue onto survivors — every post-publish
+    bring-up performs ZERO local compiles, and the warm scale-up is
+    faster than the cold one."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+    jax = _virtual_cpu_init(1)
+    import numpy as np
+
+    import jax.numpy as jnp
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import chaos, observe
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        FleetConfig, Request, ServeConfig, ServeFleet, oracle_generate,
+        spin_up_replica, warm_serving,
+    )
+
+    # Heavier per-token math than phase_serving's model: decode steps
+    # must dominate the controller/GIL overhead for replica-thread
+    # parallelism (XLA releases the GIL while executing) to show up in
+    # tokens/s.
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=96, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=192, max_seq_len=64, dtype=jnp.float32,
+    )
+    scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                       max_pages_per_seq=4, prefill_buckets=(8, 16))
+
+    def storm(tag):
+        rng = np.random.RandomState(7)
+        return [
+            Request(f"{tag}{i}", [int(t) for t in
+                                  rng.randint(0, cfg.vocab_size,
+                                              size=2 + int(rng.randint(12)))],
+                    max_new_tokens=12 + int(rng.randint(5)),
+                    arrival_step=0)
+            for i in range(16)
+        ]
+
+    def check_oracle(fl, reqs, results):
+        for r in reqs:
+            want, _ = oracle_generate("llama", cfg, fl.params,
+                                      r.tokens, r.max_new_tokens)
+            if results[r.rid] != want:
+                raise RuntimeError(
+                    f"fleet output diverged from the unbatched oracle "
+                    f"on {r.rid}"
+                )
+
+    jax.devices()
+    out = {"model_d": cfg.d_model, "n_layers": cfg.n_layers,
+           "max_batch": scfg.max_batch,
+           "host_cpu_count": os.cpu_count()}
+    reg = tempfile.mkdtemp(prefix="tdx_fleet_bench_reg_")
+    caches = []
+
+    def fresh_cache(tag):
+        d = tempfile.mkdtemp(prefix=f"tdx_fleet_bench_{tag}_")
+        caches.append(d)
+        return d
+
+    try:
+        # COLD: empty cache, no registry — the scale-up latency a fleet
+        # without artifact sharing pays for every new replica.
+        mat._reset_cache_binding()
+        with tdx_config.override(cache_dir=fresh_cache("cold")):
+            t0 = time.perf_counter()
+            spin_up_replica(cfg, family="llama", serve_cfg=scfg)
+            out["bring_up_cold_s"] = round(time.perf_counter() - t0, 3)
+
+        # Publish the program set once, then every fleet below brings
+        # replicas up through the registry into one fresh local cache.
+        # Between stages, drop jax's in-memory executable caches: this
+        # one process runs ~11 replica bring-ups plus per-shape oracle
+        # programs, and the retained JIT code regions pile up mappings
+        # until mmap hits vm.max_map_count (ENOMEM with RAM to spare).
+        # Rebuilds stay off the compiler — they re-load from the local
+        # disk cache, so the zero-local-compile gate is unaffected.
+        jax.clear_caches()
+        mat._reset_cache_binding()
+        warm_serving("llama", cfg, fresh_cache("pub"), registry_dir=reg,
+                     serve_cfg=scfg)
+        mat._reset_cache_binding()
+        observe.enable(True)
+        base = {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+        fleet_cache = fresh_cache("fleet")
+
+        # Warm mid-run scale-up, timed per replica by the fleet itself.
+        with tdx_config.override(cache_dir=fleet_cache, registry_dir=reg):
+            with ServeFleet(cfg, family="llama", serve_cfg=scfg,
+                            fleet_cfg=FleetConfig(min_replicas=1,
+                                                  max_replicas=2,
+                                                  autoscale=False,
+                                                  stall_s=120.0)) as fl:
+                fl.start(1, timeout=240.0)
+                h = fl.scale_up(wait=True, timeout=240.0)
+                out["fleet_scale_up_warm_s"] = round(h.bring_up_seconds, 3)
+                if not h.bring_up_warm:
+                    raise RuntimeError(
+                        f"warm scale-up hit the compiler: "
+                        f"{h.engine.bring_up_outcomes}"
+                    )
+        out["fleet_scaleup_warm_speedup"] = round(
+            out["bring_up_cold_s"] / out["fleet_scale_up_warm_s"], 3
+        )
+        if out["fleet_scaleup_warm_speedup"] <= 1:
+            raise RuntimeError(
+                f"registry-warm scale-up not faster than cold compile: "
+                f"{out['fleet_scale_up_warm_s']}s vs "
+                f"{out['bring_up_cold_s']}s"
+            )
+
+        # The same storm through 1 → 2 → 4 replicas, autoscale off.
+        tps = {}
+        with tdx_config.override(cache_dir=fleet_cache, registry_dir=reg):
+            for n in (1, 2, 4):
+                jax.clear_caches()
+                with ServeFleet(cfg, family="llama", serve_cfg=scfg,
+                                fleet_cfg=FleetConfig(min_replicas=n,
+                                                      max_replicas=n,
+                                                      autoscale=False,
+                                                      stall_s=120.0)) as fl:
+                    fl.start(n, timeout=240.0)
+                    reqs = storm(f"s{n}_")
+                    t0 = time.perf_counter()
+                    results = fl.run(reqs, max_seconds=240.0)
+                    dt = time.perf_counter() - t0
+                    check_oracle(fl, reqs, results)
+                    n_tok = sum(len(results[r.rid]) for r in reqs)
+                    tps[n] = round(n_tok / dt, 2)
+            out["fleet_tokens_per_s"] = {str(n): v for n, v in tps.items()}
+            out["storm_requests"] = 16
+            out["storm_tokens"] = n_tok
+            out["fleet_scaling_efficiency_2r"] = round(tps[2] / tps[1], 3)
+            if (os.cpu_count() or 1) >= 2 and tps[2] <= tps[1]:
+                raise RuntimeError(
+                    f"2 replicas no faster than 1: {tps[2]} <= {tps[1]} "
+                    f"tokens/s"
+                )
+
+            # Chaos: the same storm with replica 2 killed mid-batch —
+            # the fault may cost latency, never a token.
+            jax.clear_caches()
+            with ServeFleet(cfg, family="llama", serve_cfg=scfg,
+                            fleet_cfg=FleetConfig(min_replicas=2,
+                                                  max_replicas=2,
+                                                  autoscale=False,
+                                                  stall_s=120.0)) as fl:
+                fl.start(2, timeout=240.0)
+                chaos.install("fleet@2=raise")
+                try:
+                    reqs = storm("k")
+                    results = fl.run(reqs, max_seconds=240.0)
+                finally:
+                    chaos.clear()
+                check_oracle(fl, reqs, results)
+                if fl.rejected:
+                    raise RuntimeError(
+                        f"chaos storm rejected requests: {fl.rejected}"
+                    )
+        snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+        out["chaos_requeued"] = int(
+            snap.get("tdx.fleet.requeued_requests", 0)
+            - base.get("tdx.fleet.requeued_requests", 0))
+        if out["chaos_requeued"] < 1:
+            raise RuntimeError("chaos kill never forced a requeue")
+        miss = (snap.get("tdx.jax.compile_cache_miss", 0)
+                - base.get("tdx.jax.compile_cache_miss", 0))
+        out["warm_local_compiles"] = int(miss)
+        if miss:
+            raise RuntimeError(
+                f"registry-warm fleet paid {int(miss)} local compiles"
+            )
+        out["oracle_equal"] = True
+    finally:
+        observe.enable(None)
+        mat._reset_cache_binding()
+        shutil.rmtree(reg, ignore_errors=True)
+        for d in caches:
+            shutil.rmtree(d, ignore_errors=True)
+    out["backend"] = "cpu"
+    return out
+
+
 def phase_pp_bubble() -> dict:
     """STATIC schedule analysis (no hardware, no wall clocks — tick
     counts and buffer sizes are properties of the schedule tables, so
@@ -1834,6 +2037,7 @@ PHASES = {
     "pp_bubble": phase_pp_bubble,
     "schedule_measured": phase_schedule_measured,
     "serving": phase_serving,
+    "serving_fleet": phase_serving_fleet,
     "train_mfu": phase_train_mfu,
     "materialize_pipeline": phase_materialize_pipeline,
     "materialize_bandwidth": phase_materialize_bandwidth,
@@ -2434,6 +2638,19 @@ def main() -> None:
     else:
         out["serving_error"] = sv["error"][-160:]
 
+    sf = _run_phase("serving_fleet", timeout=900.0)
+    sf.pop("_backend", None)  # forced-CPU fleet scaling A/B: cpu by design
+    if "error" not in sf:
+        out["serving_fleet"] = sf
+        # Promoted headline keys: cold-compile vs registry-warm scale-up,
+        # and router throughput scaling 1 -> 2 replicas.
+        if sf.get("fleet_scaleup_warm_speedup") is not None:
+            out["fleet_scaleup_warm_speedup"] = sf["fleet_scaleup_warm_speedup"]
+        if sf.get("fleet_scaling_efficiency_2r") is not None:
+            out["fleet_scaling_efficiency_2r"] = sf["fleet_scaling_efficiency_2r"]
+    else:
+        out["serving_fleet_error"] = sf["error"][-160:]
+
     if not fallback:
         for name in ("flash", "flash_bwd", "flash_bias"):
             r = _run_phase(name, timeout=900.0, cache_fallback=True)
@@ -2473,6 +2690,7 @@ _HEADLINE_KEYS = (
     "materialize_gbps", "materialize_link_utilization", "pipeline_speedup",
     "materialize_bandwidth_gbps", "materialize_bandwidth_utilization",
     "reshard_gbps", "reshard_bytes_moved",
+    "fleet_scaleup_warm_speedup", "fleet_scaling_efficiency_2r",
     "train_mfu", "train_mfu_xla", "train_tokens_per_s", "train_step_ms",
     "train_stale_s", "train_mfu_skipped", "train_mfu_error",
     "flash_mfu", "flash_speedup", "flash_bwd_mfu", "flash_bwd_speedup",

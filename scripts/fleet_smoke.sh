@@ -1,0 +1,110 @@
+#!/usr/bin/env bash
+# Fleet smoke (make fleet-smoke, docs/serving.md §Fleet): warm a replica
+# shape's serving program set into a shared artifact registry, then in a
+# FRESH process with an EMPTY local TDX_CACHE_DIR bring up a 2-replica
+# ServeFleet — every replica bring-up must perform ZERO local compiles
+# (registry-warm scale-up is the autoscaling contract) — chaos-kill one
+# replica mid-storm (fleet@2=raise), and assert the router requeued its
+# work onto the survivor + backfill with every response equal to the
+# unbatched oracle; finally exercise a warm mid-run scale-up and a
+# drain-based scale-down.  CPU-only, bounded; the in-process
+# equivalents live in tests/test_fleet.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TDX_CACHE_MIN_COMPILE_S=0
+
+TMP=$(mktemp -d /tmp/tdx_fleet_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+REG="$TMP/registry"
+
+echo "== decode-program warm: init + prefill buckets + decode published =="
+python tools/warm_cache.py --decode --model tiny --cache-dir "$TMP/warm" \
+    --registry-dir "$REG" --serve-batch 2 --page-size 8 --pages 32 \
+    --max-pages-per-seq 4 --prefill-buckets 8,16 \
+    > "$TMP/warm.json" 2> "$TMP/warm.log"
+grep '^warm:' "$TMP/warm.log" | sed 's/^/  /'
+
+echo "== fresh-process fleet: 2 warm replicas, chaos kill, storm == oracle =="
+TDX_CACHE_DIR="$TMP/fresh" TDX_REGISTRY_DIR="$REG" python - <<'EOF'
+import time
+
+import numpy as np
+
+from torchdistx_tpu import chaos, observe
+from torchdistx_tpu.serve import (
+    FleetConfig, Request, ServeConfig, ServeFleet, oracle_generate,
+)
+
+observe.enable(True)
+scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                   max_pages_per_seq=4, prefill_buckets=(8, 16))
+fl = ServeFleet("tiny", serve_cfg=scfg,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=3,
+                                      autoscale=False, stall_s=60.0))
+fl.start(2, timeout=240.0)
+
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+miss = snap.get("tdx.jax.compile_cache_miss", 0)
+hit = snap.get("tdx.jax.compile_cache_hit", 0)
+assert miss == 0, (
+    f"fleet bring-up paid {miss} local compiles: "
+    f"{[h.engine.bring_up_outcomes for h in fl.handles]}")
+assert hit >= 8, hit  # 4 programs × 2 replicas, all registry-fed
+assert all(h.bring_up_warm for h in fl.handles)
+warm_s = [round(h.bring_up_seconds, 2) for h in fl.handles]
+print(f"  bring-up: 2 replicas warm, 0 local compiles ({warm_s}s)")
+
+# Chaos: kill replica 2 mid-batch; the storm must not lose a token.
+chaos.install("fleet@2=raise")
+try:
+    rng = np.random.RandomState(11)
+    reqs = [
+        Request(f"r{i}",
+                [int(t) for t in rng.randint(0, 256,
+                                             size=1 + int(rng.randint(12)))],
+                max_new_tokens=2 + int(rng.randint(6)),
+                arrival_step=i)
+        for i in range(8)
+    ]
+    out = fl.run(reqs, max_seconds=240.0)
+finally:
+    chaos.clear()
+
+assert set(out) == {r.rid for r in reqs}
+assert not fl.rejected, fl.rejected
+for r in reqs:
+    want, want_logits = oracle_generate(
+        fl.family, fl.cfg, fl.params, r.tokens, r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, out[r.rid], want)
+    np.testing.assert_allclose(fl.final_logits[r.rid], want_logits,
+                               atol=1e-4)
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+assert snap.get("tdx.fleet.requeued_requests", 0) >= 1, snap
+assert snap.get("tdx.fleet.scale_ups", 0) >= 3, snap  # 2 start + backfill
+assert all(h.idx != 2 for h in fl.handles)  # the killed replica is gone
+print(f"  OK: {len(reqs)} responses == oracle through a replica kill "
+      f"({int(snap['tdx.fleet.requeued_requests'])} requeued)")
+
+# Warm mid-run scale-up, then drain-based scale-down.
+h = fl.scale_up(wait=True, timeout=240.0)
+assert h.bring_up_warm, h.engine.bring_up_outcomes
+d = fl.scale_down()
+deadline = time.monotonic() + 60.0
+while any(x is d for x in fl.handles):
+    fl.tick()
+    assert time.monotonic() < deadline, d.state
+    time.sleep(0.005)
+assert d.state == "drained" and d.engine.k_pages is None
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+assert snap.get("tdx.fleet.scale_downs", 0) >= 1
+fl.shutdown()
+print(f"  OK: warm scale-up ({h.bring_up_seconds:.2f}s) + drained "
+      f"scale-down, KV pool freed")
+EOF
+
+echo "fleet-smoke OK"

@@ -44,7 +44,7 @@ else:
     raise SystemExit("expected MaterializationError")
 EOF
 
-echo "== 3. chaos serve fault: dump + oracle-equal outputs + LIVE endpoint scrapes =="
+echo "== 3. chaos serve fault: dump + oracle outputs + LIVE scrapes + fleet /readyz =="
 TDX_FLIGHT_DIR="$FLIGHT" TDX_FAULT_PLAN='serve@2=raise' \
 TDX_METRICS_EXPORT_S=0.2 TDX_METRICS_PATH="$TMP/flight/%h/metrics.prom" \
 TDX_OBS_PORT=0 TDX_OBS_PORT_FILE="$TMP/obs.port" \
@@ -57,7 +57,8 @@ import urllib.request
 
 from torchdistx_tpu import observe
 from torchdistx_tpu.serve import (
-    Request, ServeConfig, oracle_generate, spin_up_replica,
+    FleetConfig, Request, ServeConfig, ServeFleet, oracle_generate,
+    spin_up_replica,
 )
 
 
@@ -132,6 +133,35 @@ assert "ttft" in slo and "token" in slo, slo
 time.sleep(0.5)  # let the periodic exporter fire at least once
 print(f"  {len(reqs)} requests == oracle through the fault; live /slo "
       f"p50 TTFT {live['ttft']['p50']*1e3:.1f}ms")
+
+# Fleet /readyz aggregation: once fleet/<r> components exist, the probe
+# is 503 until >=1 replica serves (the non-fleet `serve` component above
+# is still green the whole time), then 200 with a per-replica roster;
+# shutdown clears the fleet view and the probe stays 200 on `serve`.
+fl = ServeFleet("tiny", serve_cfg=scfg,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=2,
+                                      autoscale=False, stall_s=60.0))
+fl.start(2, wait=False)
+codes, deadline = [], time.monotonic() + 240.0
+while True:
+    assert time.monotonic() < deadline, set(codes)
+    status, body = get("/readyz")
+    codes.append(status)
+    doc = json.loads(body)
+    if status == 200 and doc.get("fleet", {}).get("serving", 0) >= 1:
+        break
+    time.sleep(0.01)
+assert 503 in codes, f"fleet bring-up never gated /readyz: {set(codes)}"
+fl.wait_replicas(2, timeout=240.0)
+status, body = get("/readyz")
+doc = json.loads(body)
+assert status == 200 and len(doc["fleet"]["replicas"]) == 2, doc
+assert doc["fleet"]["serving"] >= 1, doc
+fl.shutdown()
+status, body = get("/readyz")
+assert status == 200 and "fleet" not in json.loads(body), body
+print(f"  /readyz fleet view: 503 while 0/2 serving "
+      f"({codes.count(503)} polls) -> 200 with 2-replica roster")
 EOF
 test ! -e "$TMP/obs.port"  # clean shutdown removed the port file
 

@@ -86,6 +86,13 @@ _HOST_PHASES = {
         "ttft_warm_speedup": 5.34, "decode_tokens_per_s": 1360.0,
         "warm_local_compiles": 0, "oracle_equal": True,
         "backend": "cpu", "_backend": "cpu"},
+    "serving_fleet": {
+        "bring_up_cold_s": 4.3, "fleet_scale_up_warm_s": 0.81,
+        "fleet_scaleup_warm_speedup": 5.26,
+        "fleet_tokens_per_s": {"1": 944.6, "2": 1111.0, "4": 1027.1},
+        "fleet_scaling_efficiency_2r": 1.176, "chaos_requeued": 4,
+        "warm_local_compiles": 0, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "schedule_measured": {"schedule_measured": {
         "gpipe_step_ms": 1769.0, "flat_1f1b_step_ms": 2509.0,
         "interleaved_step_ms": 2078.0, "interleaved_vs_flat_measured": 1.208,
@@ -151,6 +158,9 @@ def test_healthy_branch_headline_and_detail(bench):
     assert full["llama_big_param_dtype"] == "bfloat16"
     assert headline["pipeline_speedup"] == 1.408
     assert headline["reshard_gbps"] == 0.327
+    assert headline["fleet_scaleup_warm_speedup"] == 5.26
+    assert headline["fleet_scaling_efficiency_2r"] == 1.176
+    assert full["serving_fleet"]["chaos_requeued"] == 4
     assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208

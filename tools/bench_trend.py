@@ -58,6 +58,13 @@ GATES: List[Tuple[str, str, float]] = [
     # Topology-migration throughput (bench.py reshard phase, r06 on):
     # disk+memcpy bound, so same-host runs are fairly tight.
     (r"^reshard_gbps$", "up", 0.20),
+    # Fleet scale-up + scaling headlines (bench.py serving_fleet phase):
+    # cold-compile vs registry-warm bring-up swings with compiler wall
+    # clock, and CPU-thread scaling wobbles with host load — both need
+    # looser floors than the generic _speedup gate below, and must stay
+    # ABOVE it (gate_for returns the first match).
+    (r"^fleet_scaleup_warm_speedup$", "up", 0.30),
+    (r"^fleet_scaling_efficiency_2r$", "up", 0.20),
     (r"_speedup$", "up", 0.15),
     (r"_mfu$", "up", 0.15),
     (r"_rss_mb$", "down", 0.15),

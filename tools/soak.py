@@ -57,6 +57,19 @@ batching, paging, preemption, and faults must never change a token:
     python tools/soak.py --modes serve --seconds 300 \\
         --fault-plan 'serve@2=raise;serve@5=slow:0.1'
 
+The ``fleet`` mode soaks the multi-replica serve fleet one layer up
+(docs/serving.md §Fleet): each seed brings up a randomized fleet,
+drives a randomized staggered storm through the router while an
+aggressive autoscaler oscillates the replica count, injects a ``fleet``
+fault plan (replica kills mid-batch — raise / thread-preempt / hang
+caught by stall detection), forces at least one scale-up and one
+drain-based scale-down mid-storm, and asserts every response equals the
+unbatched oracle and nothing was rejected — replica loss and scale
+churn must never change a token:
+
+    python tools/soak.py --modes fleet --seconds 300 \\
+        --fault-plan 'fleet@2=raise'
+
 The ``reshard`` mode soaks the topology-migrating checkpoint
 redistributor (docs/robustness.md §Resharding): each seed saves a
 randomized state, rechunk-copies it through a randomized pair of
@@ -87,7 +100,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
          "geom", "geom_single", "geom_bridge", "elastic", "materialize",
-         "registry", "serve", "reshard")
+         "registry", "serve", "fleet", "reshard")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -563,6 +576,148 @@ def _serve_oracle(seed: int, plan_text: "str | None"):
     return None
 
 
+def _fleet_oracle(seed: int, plan_text: "str | None"):
+    """One fleet-correctness run: a randomized storm through a
+    randomized multi-replica fleet under replica-kill chaos and forced
+    scale oscillation (≥1 scale-up + ≥1 drain mid-storm, plus whatever
+    the aggressive autoscaler adds) — every response must equal the
+    unbatched oracle and nothing may be rejected."""
+    import random
+    import shutil
+    import tempfile
+    import time as _time
+
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu import config as tdx_config
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        FleetConfig,
+        Request,
+        ServeConfig,
+        ServeFleet,
+        oracle_generate,
+        serve_program_specs,
+    )
+    from torchdistx_tpu.serve.programs import compile_serving_program
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = random.Random(seed)
+    cfg = TransformerConfig(
+        vocab_size=rng.choice([96, 128]),
+        d_model=rng.choice([32, 48]),
+        n_layers=rng.randrange(1, 3),
+        n_heads=4,
+        n_kv_heads=rng.choice([2, 4]),
+        d_ff=64,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    scfg = ServeConfig(
+        max_batch=rng.randrange(2, 4),
+        page_size=rng.choice([4, 8]),
+        n_pages=rng.randrange(10, 16),
+        max_pages_per_seq=4,
+        prefill_buckets=(8,),
+    )
+    resolved = scfg.resolve(cfg)
+    family = "llama"
+    # Independent oracle params: the seed identity with the fleet's
+    # replicas (same deferred-init seed → identical params) is exactly
+    # what makes cross-replica token equality meaningful.
+    specs = serve_program_specs(family, cfg, scfg, seed=seed % 7)
+    init = specs[0]
+    compiled, _ = compile_serving_program(init)
+    params = jax.tree.unflatten(init.treedef, list(compiled()))
+
+    n_req = rng.randrange(4, 9)
+    reqs = []
+    for i in range(n_req):
+        prompt = [rng.randrange(cfg.vocab_size) for _ in
+                  range(rng.randrange(1, 8))]
+        budget = rng.randrange(1, 1 + min(
+            8, resolved.max_context - len(prompt)))
+        reqs.append(Request(
+            f"r{i}", prompt, max_new_tokens=budget,
+            arrival_step=rng.randrange(0, 7),
+        ))
+
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        entries = []
+        for _ in range(rng.randrange(1, 3)):
+            kind = rng.choice(["raise", "preempt", "hang"])
+            arg = ":3600" if kind == "hang" else ""
+            entries.append(f"fleet@{rng.randrange(1, 4)}={kind}{arg}")
+        plan = chaos.parse_plan(";".join(entries))
+
+    fc = FleetConfig(
+        min_replicas=1, max_replicas=3,
+        dispatch_per_replica=1.0,           # backlog visible → pressure
+        up_queue_per_replica=2.0, up_consecutive=1,
+        down_consecutive=3, cooldown_s=0.05,
+        stall_s=0.75,                       # hang kills get declared fast
+        autoscale=True,
+    )
+    cache = tempfile.mkdtemp(prefix="tdx_soak_fleet_")
+    chaos.install(plan)
+    old_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ["TDX_CACHE_MIN_COMPILE_S"] = "0"
+    try:
+        with tdx_config.override(cache_dir=cache):
+            with ServeFleet(cfg, family=family, serve_cfg=scfg,
+                            seed=seed % 7, fleet_cfg=fc) as fl:
+                fl.start(rng.randrange(1, 3), timeout=240.0)
+                arrivals = sorted(reqs, key=lambda r: r.arrival_step)
+                did_up = did_down = False
+                i = 0
+                deadline = _time.monotonic() + 240.0
+                while i < len(arrivals) or fl._pending:
+                    while (i < len(arrivals)
+                           and arrivals[i].arrival_step <= fl._tick_no):
+                        fl.submit(arrivals[i])
+                        i += 1
+                    fl.tick()
+                    serving = sum(1 for h in fl.handles
+                                  if h.state == "serving")
+                    if not did_up and i >= n_req // 2:
+                        fl.scale_up()       # forced ≥1 scale-up
+                        did_up = True
+                    if did_up and not did_down and serving > 1 and i >= n_req:
+                        fl.scale_down()     # forced ≥1 drain
+                        did_down = True
+                    if _time.monotonic() > deadline:
+                        return ("hang",
+                                f"fleet storm stuck: pending={fl._pending} "
+                                f"states={[h.state for h in fl.handles]} "
+                                f"plan={plan!r}")
+                    _time.sleep(0.001)
+                out = dict(fl.results)
+                if fl.rejected:
+                    return ("mismatch",
+                            f"unexpected rejections {fl.rejected} "
+                            f"plan={plan!r}")
+    finally:
+        chaos.clear()
+        mat._reset_cache_binding()
+        if old_min is None:
+            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["TDX_CACHE_MIN_COMPILE_S"] = old_min
+        shutil.rmtree(cache, ignore_errors=True)
+    for r in reqs:
+        want, _ = oracle_generate(family, cfg, params, r.tokens,
+                                  r.max_new_tokens, r.eos_id)
+        if out.get(r.rid) != want:
+            return ("mismatch",
+                    f"{r.rid}: fleet={out.get(r.rid)} oracle={want} "
+                    f"plan={plan!r}")
+    return None
+
+
 def _run_seed(mode: str, seed: int):
     """Run one oracle; returns None on pass/skip, (kind, message) else."""
     import random
@@ -624,6 +779,10 @@ def _run_seed(mode: str, seed: int):
             r = _serve_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
+        elif mode == "fleet":
+            r = _fleet_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "reshard":
             r = _reshard_oracle(seed, _FAULT_PLAN)
             if r is not None:
@@ -667,7 +826,7 @@ def main() -> int:
                                                   "soak_failures.jsonl"))
     ap.add_argument("--fault-plan", default=None,
                     help="chaos plan for --modes elastic/materialize/"
-                         "registry/serve/reshard (grammar: "
+                         "registry/serve/fleet/reshard (grammar: "
                          "torchdistx_tpu.chaos / docs/robustness.md); "
                          "default: a seeded-random plan per seed")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",

@@ -37,7 +37,9 @@ Fault kinds and what they model:
              file is touched; the reshard verify stage catches it)
 ``slow``     a save that takes extra seconds — checkpoint latency
              hiding the preemption deadline
-``preempt``  SIGTERM to self — the *announced* preemption notice
+``preempt``  SIGTERM to self — the *announced* preemption notice; at the
+             ``fleet`` site it kills only the replica THREAD
+             (:class:`ReplicaPreempted`), modeling replica loss
 ===========  ==========================================================
 
 The materialization sites fire inside the record→compile→materialize
@@ -52,7 +54,11 @@ quarantine path is exercised for real.  The ``reshard`` site fires once
 per transfer chunk inside :mod:`torchdistx_tpu.reshard` (1-based chunk
 number): a failed reshard quarantines nothing and leaves the source
 checkpoint untouched — it surfaces as a typed ``ReshardError``
-(docs/robustness.md §Resharding).
+(docs/robustness.md §Resharding).  The ``fleet`` site fires inside a
+fleet replica's serving thread, keyed by 1-based replica id (not step):
+the controller (:mod:`torchdistx_tpu.serve.fleet`) requeues the dead
+replica's requests onto survivors — a replica kill costs latency, never
+a token (docs/serving.md §Fleet).
 """
 
 from __future__ import annotations
@@ -62,10 +68,12 @@ from typing import List, Optional, Union
 
 from .inject import (
     InjectedRuntimeError,
+    ReplicaPreempted,
     corrupt_cache_dir,
     corrupt_checkpoint,
     corrupt_registry_dir,
     execute,
+    execute_replica_fault,
     set_cancel_event,
 )
 from .plan import KINDS, SITES, Fault, FaultPlan, parse_plan
@@ -75,12 +83,14 @@ __all__ = [
     "FaultPlan",
     "InjectedRuntimeError",
     "KINDS",
+    "ReplicaPreempted",
     "SITES",
     "active_plan",
     "clear",
     "corrupt_cache_dir",
     "corrupt_checkpoint",
     "corrupt_registry_dir",
+    "execute_replica_fault",
     "install",
     "maybe_inject",
     "parse_plan",

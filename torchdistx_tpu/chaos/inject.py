@@ -39,6 +39,13 @@ class InjectedRuntimeError(RuntimeError):
     """Fallback for ``raise`` faults when XlaRuntimeError cannot be built."""
 
 
+class ReplicaPreempted(InjectedRuntimeError):
+    """A ``fleet`` site ``preempt``: the replica THREAD is killed (its
+    controller sees a dead replica and requeues its work), the process
+    lives.  Distinct from the process-level ``preempt`` of the elastic
+    sites, which SIGTERMs — a fleet models replica loss, not job loss."""
+
+
 _tls = threading.local()
 
 
@@ -131,6 +138,25 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
             corrupt_checkpoint(path, mode=fault.arg or "truncate")
         return
     raise AssertionError(f"unreachable fault kind {fault.kind!r}")
+
+
+def execute_replica_fault(fault: Fault) -> None:
+    """Perform a ``fleet``-site fault inside a replica thread.  Same
+    telemetry contract as :func:`execute` (counter + instant +
+    flight-dump before acting), but ``preempt`` raises
+    :class:`ReplicaPreempted` to kill only the CALLING replica thread —
+    a process-level SIGTERM would take the whole fleet down with it,
+    which is the ``step`` site's job, not this one's."""
+    if fault.kind == "preempt":
+        log = get_logger()
+        observe.counter("tdx.chaos.injected", kind=fault.kind).inc()
+        observe.instant("chaos.injected", category="chaos", spec=fault.spec())
+        observe.flight_dump("chaos_injected", spec=fault.spec())
+        log.warning("chaos: injecting %s (replica-thread preempt)", fault.spec())
+        raise ReplicaPreempted(
+            f"chaos: injected replica preemption ({fault.spec()})"
+        )
+    execute(fault)
 
 
 def _damage_file(f: Path, mode: str) -> None:

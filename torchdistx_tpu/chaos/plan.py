@@ -15,6 +15,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
            | 'lower' | 'compile' | 'execute' | 'cache'  (materialization)
            | 'registry'                             (artifact registry)
            | 'serve'                                (serving engine)
+           | 'fleet'                                (fleet replica)
            | 'reshard'                              (checkpoint reshard)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
 
@@ -33,6 +34,9 @@ Examples::
     serve@3=raise                # replica fault at engine step 3: every
                                  # active request is requeued and
                                  # regenerated (recompute preemption)
+    fleet@2=raise                # kill fleet replica 2 mid-batch: its
+                                 # active requests requeue onto the
+                                 # surviving replicas
     reshard@2=corrupt:flip       # bit-flip the 2nd in-flight transfer
                                  # chunk of a checkpoint reshard (caught
                                  # by the bitwise verify stage)
@@ -54,7 +58,13 @@ so an injected registry fault costs savings, never correctness).  The
 step number; kinds ``raise`` / ``slow``): a raised fault mid-batch
 requeues every active request, which greedy decode then regenerates
 identically — a replica fault costs latency, never a wrong token
-(docs/serving.md).  The ``reshard`` site fires once per transfer chunk
+(docs/serving.md).  The ``fleet`` site is keyed by 1-based REPLICA ID
+rather than step: it fires inside the named replica's serving thread
+while that replica has a batch in flight (kinds ``raise`` / ``hang`` /
+``preempt`` — ``preempt`` kills only the replica thread, via
+:class:`..inject.ReplicaPreempted`, never the process), and the fleet
+controller requeues the dead replica's requests onto survivors
+(docs/serving.md §Fleet).  The ``reshard`` site fires once per transfer chunk
 of a checkpoint redistribution (1-based chunk number; kinds ``raise`` /
 ``slow`` / ``corrupt``): ``corrupt`` damages the engine's in-flight
 chunk buffer — never any file — so the reshard verify stage catches it,
@@ -70,7 +80,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
-         "registry", "serve", "reshard")
+         "registry", "serve", "fleet", "reshard")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
 
 _ENTRY_RE = re.compile(

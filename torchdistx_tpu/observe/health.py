@@ -9,7 +9,9 @@ Two small registries, both process-wide and thread-safe:
   READY state (``serving`` / ``ready``) — so a load balancer cannot
   route to a replica whose program set is still compiling/fetching.  A
   process with no registered components is trivially ready (a bench or
-  train process has no bring-up gate).
+  train process has no bring-up gate).  Components named ``fleet/<r>``
+  are fleet replicas and aggregate: ready iff ≥1 replica is serving,
+  with the per-replica states listed in the probe body.
 * **heartbeats** — a loop that can wedge (the elastic step loop, under
   its step watchdog) beats once per iteration with a period hint;
   ``/healthz`` returns 503 when any heartbeat is older than its
@@ -28,8 +30,10 @@ import time
 from typing import Dict, Optional, Tuple
 
 __all__ = [
+    "FLEET_PREFIX",
     "READY_STATES",
     "beat",
+    "clear_state",
     "liveness",
     "readiness",
     "reset",
@@ -39,6 +43,11 @@ __all__ = [
 
 # Terminal bring-up states that count as ready for /readyz.
 READY_STATES = ("serving", "ready")
+
+# Components named "fleet/<replica>" are fleet replicas and aggregate:
+# the fleet is ready when AT LEAST ONE replica is, so a replica
+# mid-bring-up (or mid-drain) never 503s a fleet that is still serving.
+FLEET_PREFIX = "fleet/"
 
 _MIN_ALLOWANCE_S = 15.0
 
@@ -57,6 +66,13 @@ def set_state(component: str, state: str) -> None:
 
     if enabled():
         instant(f"{component}.state", category="health", state=state)
+
+
+def clear_state(component: str) -> None:
+    """Forget a component (a fleet replica that scaled away): a removed
+    replica must stop counting toward — or against — readiness."""
+    with _lock:
+        _states.pop(component, None)
 
 
 def beat(name: str, period_hint_s: Optional[float] = None) -> None:
@@ -107,12 +123,34 @@ def liveness() -> Tuple[bool, dict]:
 
 def readiness() -> Tuple[bool, dict]:
     """(ready, detail) for /readyz: every registered component must be
-    in a READY state; none registered → trivially ready."""
+    in a READY state; none registered → trivially ready.
+
+    ``fleet/*`` components are fleet replicas and aggregate instead of
+    gating individually: the fleet contributes ready iff ≥1 replica is
+    in a READY state, and the detail carries a ``fleet`` view listing
+    every replica's bring-up state (the per-replica body the ops-plane
+    ``/readyz`` serves, docs/serving.md §Fleet)."""
     detail = snapshot()
+    fleet = {
+        name: info for name, info in detail["states"].items()
+        if name.startswith(FLEET_PREFIX)
+    }
     not_ready = {
         name: info["state"] for name, info in detail["states"].items()
-        if info["state"] not in READY_STATES
+        if info["state"] not in READY_STATES and name not in fleet
     }
+    if fleet:
+        serving = sum(
+            1 for info in fleet.values() if info["state"] in READY_STATES
+        )
+        detail["fleet"] = {
+            "replicas": {
+                name[len(FLEET_PREFIX):]: info for name, info in fleet.items()
+            },
+            "serving": serving,
+        }
+        if serving == 0:
+            not_ready["fleet"] = "no replica serving"
     if not_ready:
         detail["not_ready"] = not_ready
     return (not not_ready), detail

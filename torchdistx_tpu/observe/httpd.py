@@ -17,6 +17,10 @@ scrapeable LIVE from the running process, with the same rendering code
     Readiness: 200 only when every registered bring-up component is in
     a ready state — a serve replica flips true only after its program
     set is compiled/fetched (``spin_up`` → ``warming`` → ``serving``).
+    ``fleet/<r>`` components aggregate instead of gating individually:
+    the probe is 200 iff at least one fleet replica is serving, and the
+    body's ``fleet`` key carries the per-replica state roster plus the
+    live ``serving`` count (one dead replica of N never fails the pod).
 ``/slo``
     Every live :class:`~.slo.ServeSLO`'s sliding-window percentiles as
     JSON (:func:`.slo.snapshot_all`).

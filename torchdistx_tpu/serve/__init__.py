@@ -17,10 +17,29 @@ Quick tour::
     out = eng.run([Request("r0", [1, 2, 3], max_new_tokens=8)])
     # out["r0"] == the greedy continuation; equal to the unbatched
     # oracle (serve.oracle_generate) by contract.
+
+Above the single engine sits the fleet layer (:mod:`.fleet` +
+:mod:`.router`): N replicas behind one bounded admission queue with
+least-outstanding-work routing, an SLO-driven autoscaler (drain-based
+scale-down), and chaos-killable replicas whose requests requeue onto
+survivors — same token-exactness contract, fleet-wide::
+
+    from torchdistx_tpu.serve import FleetConfig, ServeFleet
+
+    with ServeFleet("tiny", fleet_cfg=FleetConfig(min_replicas=2)) as fl:
+        fl.start()
+        out = fl.run([Request("r0", [1, 2, 3], max_new_tokens=8)])
 """
 
 from .engine import Request, ServeEngine, oracle_generate, spin_up_replica
+from .fleet import Autoscaler, FleetConfig, ReplicaHandle, ServeFleet
 from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
+from .router import (
+    AdmissionQueue,
+    FleetRejected,
+    Rejection,
+    least_outstanding,
+)
 from .programs import (
     ServeConfig,
     ServeProgramSpec,
@@ -32,17 +51,25 @@ from .programs import (
 )
 
 __all__ = [
+    "AdmissionQueue",
+    "Autoscaler",
+    "FleetConfig",
+    "FleetRejected",
     "KVCacheConfig",
     "OutOfPages",
     "PagedKVCache",
+    "Rejection",
+    "ReplicaHandle",
     "Request",
     "ServeConfig",
     "ServeEngine",
+    "ServeFleet",
     "ServeProgramSpec",
     "build_decode_fn",
     "build_prefill_fn",
     "compile_serving_program",
     "init_pools",
+    "least_outstanding",
     "oracle_generate",
     "serve_program_specs",
     "spin_up_replica",

@@ -115,6 +115,9 @@ class ServeEngine:
         seed: int = 0,
         param_dtype=None,
         on_token: Optional[Callable[[str, int], None]] = None,
+        on_complete: Optional[Callable[[str, List[int], np.ndarray],
+                                       None]] = None,
+        slo_name: str = "serve",
     ):
         self.family = family
         self.cfg = cfg
@@ -123,6 +126,8 @@ class ServeEngine:
         self.mesh, self.plan = mesh, plan
         self._seed, self._param_dtype = seed, param_dtype
         self.on_token = on_token
+        self.on_complete = on_complete
+        self._draining = False
         self.kv = PagedKVCache(self.scfg.kv_config(cfg))
         self.k_pages, self.v_pages = init_pools(self.scfg.kv_config(cfg),
                                                 cfg.dtype)
@@ -142,7 +147,10 @@ class ServeEngine:
         self._retryable = _retryable_errors()
         from ..observe import slo as _slo
 
-        self.slo = _slo.ServeSLO()
+        # Fleet replicas pass a per-replica ``slo_name`` so the /slo
+        # endpoint (and the fleet autoscaler) see each replica's windows
+        # instead of a last-writer-wins mush.
+        self.slo = _slo.ServeSLO(name=slo_name)
         # Live percentile export for fleet scrapers; no-op unless
         # TDX_METRICS_EXPORT_S > 0 (the first engine's SLO wins the
         # exporter slot — one replica per process is the deployment
@@ -263,6 +271,60 @@ class ServeEngine:
             )
         return dict(self.results)
 
+    def drain(self, *, max_steps: int = 100_000) -> List[Request]:
+        """Scale-down hook: finish every IN-FLIGHT lane (admission is
+        suspended — a draining replica gets no new work), then hand back
+        whatever was still waiting unadmitted.  A fault mid-drain
+        requeues its lanes into ``waiting`` like any other step fault,
+        so the leftovers a drain returns are exactly the requests the
+        fleet must redistribute onto survivors."""
+        self._draining = True
+        try:
+            start = self._step_no
+            while self.active and (self._step_no - start) < max_steps:
+                self.step()
+            if self.active:
+                raise RuntimeError(
+                    f"drain hit max_steps={max_steps} with "
+                    f"{len(self.active)} lanes still active"
+                )
+            leftover = list(self.waiting)
+            self.waiting.clear()
+            return leftover
+        finally:
+            self._draining = False
+
+    def release_kv(self) -> None:
+        """Free the replica's KV pool (the end of a drain): drop the
+        page tensors and reset the allocator.  The engine can still
+        report results; it can no longer serve."""
+        if self.active:
+            raise RuntimeError(
+                f"release_kv with {len(self.active)} active lanes; "
+                f"drain first"
+            )
+        self.k_pages = self.v_pages = None
+        self.kv = PagedKVCache(self.scfg.kv_config(self.cfg))
+        self._gauges()
+
+    def outstanding_tokens(self) -> int:
+        """Remaining token budget across waiting + active requests — the
+        load signal the fleet router balances on.  Safe to call from
+        another thread: the snapshot may be momentarily stale (it's a
+        routing heuristic, not an invariant), never wrong-by-crash."""
+        for _ in range(8):
+            try:
+                waiting = list(self.waiting)
+                lanes = list(self.active.values())
+            except RuntimeError:  # resized mid-iteration; retry
+                continue
+            return (
+                sum(r.max_new_tokens for r in waiting)
+                + sum(max(1, lane.req.max_new_tokens - len(lane.generated))
+                      for lane in lanes)
+            )
+        return len(self.waiting) + len(self.active)  # coarse fallback
+
     def step(self) -> None:
         """One engine tick: chaos site → admission (+prefill) → one
         batched decode step → retirement.  A retryable runtime fault
@@ -308,6 +370,8 @@ class ServeEngine:
         return None
 
     def _admit(self) -> None:
+        if self._draining:
+            return  # a draining replica finishes lanes, admits nothing
         while self.waiting:
             req = self.waiting[0]
             if req.arrival_step > self._step_no:
@@ -470,6 +534,9 @@ class ServeEngine:
         self.results[lane.req.rid] = list(lane.generated)
         self.final_logits[lane.req.rid] = np.asarray(logits, np.float32)
         observe.counter("tdx.serve.requests_completed").inc()
+        if self.on_complete is not None:
+            self.on_complete(lane.req.rid, list(lane.generated),
+                             self.final_logits[lane.req.rid])
 
     def _preempt(self, slot: int, *, reason: str) -> None:
         """Evict a lane and requeue its whole request at the queue front
@@ -528,6 +595,9 @@ def spin_up_replica(
     sample_len: int = 8,
     warm: bool = True,
     on_token=None,
+    on_complete=None,
+    health_component: str = "serve",
+    slo_name: str = "serve",
 ) -> ServeEngine:
     """Bring up one serving replica: ``deferred_init`` the model (fakes,
     zero storage) → compile/fetch the init program through the artifact
@@ -538,6 +608,12 @@ def spin_up_replica(
 
     ``model`` is a zoo preset name (family inferred from it) or a
     :class:`TransformerConfig` (then pass ``family``).
+
+    ``health_component`` / ``slo_name`` namespace the bring-up state
+    machine and latency windows per replica — the fleet controller
+    (:mod:`.fleet`) passes ``fleet/rN`` / ``serve-rN`` so ``/readyz``
+    and ``/slo`` can tell replicas apart; a standalone replica keeps the
+    historical ``serve`` names.
     """
     if isinstance(model, str):
         cfg = PRESETS[model]
@@ -551,7 +627,7 @@ def spin_up_replica(
     # Bring-up state machine behind /readyz (observe.health): a load
     # balancer must not route here until the program set is
     # compiled/fetched and warm.
-    observe.health.set_state("serve", "spin_up")
+    observe.health.set_state(health_component, "spin_up")
     with observe.span(
         "serve.spin_up", category="serve", family=family,
         warm=bool(warm),
@@ -587,17 +663,18 @@ def spin_up_replica(
         engine = ServeEngine(
             family, cfg, params, serve_cfg=serve_cfg, mesh=mesh, plan=plan,
             seed=seed, param_dtype=param_dtype, on_token=on_token,
+            on_complete=on_complete, slo_name=slo_name,
         )
         # The spec list above already paid the model's deferred-init
         # trace; hand it to the engine so warmup/lazy compiles reuse it.
         engine._spec_cache = {s.name: s for s in specs if s.name != "init"}
         outcomes = {"init": init_outcome}
-        observe.health.set_state("serve", "warming")
+        observe.health.set_state(health_component, "warming")
         if warm:
             outcomes.update(engine.warmup())
         engine.bring_up_outcomes = outcomes
         engine.bring_up_seconds = time.perf_counter() - t0
-        observe.health.set_state("serve", "serving")
+        observe.health.set_state(health_component, "serving")
         sp.set(seconds=round(engine.bring_up_seconds, 3), **{
             f"cache_{k}": v for k, v in outcomes.items()
         })

@@ -1,0 +1,660 @@
+"""Serve fleet: N registry-warm replicas behind one router + autoscaler.
+
+The ROADMAP's serving north star — "new replica serving traffic in
+seconds because init is a cache hit" — needs a layer ABOVE the single
+continuous-batching engine (:mod:`.engine`): something that owns
+replicas, routes traffic, and scales.  This module is that layer:
+
+* **ServeFleet** — the controller.  Owns N in-process replicas, each a
+  :func:`~.engine.spin_up_replica` engine on its own daemon thread,
+  each bring-up going through the registry fetch→verify→install path so
+  a scale-up on a warmed registry is a CACHE HIT, not an XLA compile
+  (``bring_up_warm`` + ``tdx.fleet.spin_up_warm_s`` record it per
+  replica).  The controller drives everything from a single-threaded
+  :meth:`~ServeFleet.tick` loop — replica threads only serve; routing,
+  scaling, requeueing, and completion bookkeeping never race each
+  other.
+* **Router** (:mod:`.router`) — one bounded global
+  :class:`~.router.AdmissionQueue` (overflow and per-request deadline →
+  typed :class:`~.router.Rejection`), least-outstanding-WORK dispatch
+  (:func:`~.router.least_outstanding` over remaining token budget) over
+  the ready replicas, with a per-replica dispatch cap so backlog builds
+  in the global queue (where the autoscaler can see it) instead of
+  deep inside one replica.
+* **Autoscaler** — SLO-driven, pure, and hysteretic: scale up on
+  sustained queue-depth or p95-TTFT pressure (read from the replicas'
+  :mod:`..observe.slo` windows), scale down by DRAINING — a draining
+  replica finishes its in-flight lanes (:meth:`~.engine.ServeEngine.
+  drain`), gets no new work, hands back its unadmitted backlog, then
+  frees its KV pool (:meth:`~.engine.ServeEngine.release_kv`).
+  ``up_consecutive`` / ``down_consecutive`` streaks plus a cooldown
+  keep a step load change from flapping the fleet.  The
+  ``min_replicas`` floor is not a scaling decision: a dead replica is
+  backfilled even with ``autoscale=False``.
+
+**Failure semantics** reuse the chaos subsystem: the ``fleet`` site
+(keyed by 1-based replica id; kinds ``raise`` / ``hang`` / ``preempt``)
+fires inside the named replica's serving thread while it has a batch in
+flight.  The controller detects the death (terminal state, or a stalled
+heartbeat after ``stall_s``) and requeues every request the replica
+held onto the survivors — FRONT of the global queue, exempt from bound
+and deadline.  Greedy decode regenerates requeued requests
+identically and the fleet-level stream dedupe suppresses replayed
+positions, so the fleet extends the engine's recompute-preemption
+contract across replicas: **faults cost latency, never a token** —
+fleet output stays equal to the single-engine ``oracle_generate``
+across storms, staggered arrivals, replica kills, and scale
+transitions (tests/test_fleet.py, ``make fleet-smoke``).
+
+Readiness aggregates: each replica reports ``fleet/rN`` bring-up states
+into :mod:`..observe.health`, and ``/readyz`` returns 200 iff ≥1
+replica is serving, with the per-replica states in the body
+(docs/serving.md §Fleet).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import chaos, observe
+from .. import config as tdx_config
+from ..models import PRESETS, TransformerConfig
+from ..utils.logging import get_logger
+from .engine import Request, ServeEngine, spin_up_replica
+from .programs import ServeConfig, model_family
+from .router import AdmissionQueue, FleetRejected, Rejection, least_outstanding
+
+__all__ = ["Autoscaler", "FleetConfig", "ReplicaHandle", "ServeFleet"]
+
+# Replica states the controller treats as dead (requeue + remove).
+_DEAD_STATES = ("failed", "preempted")
+_TERMINAL_STATES = _DEAD_STATES + ("drained", "stopped")
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet sizing, admission, and autoscaling policy."""
+
+    min_replicas: int = 1         # backfilled even with autoscale off
+    max_replicas: int = 4
+    max_queue: int = 256          # global admission bound (queue_full)
+    dispatch_per_replica: float = 2.0  # cap = max_batch × this, queued beyond
+    up_queue_per_replica: float = 4.0  # queue pressure: queued > this × serving
+    up_ttft_p95_s: Optional[float] = None  # TTFT pressure (None = queue only)
+    up_consecutive: int = 2       # ticks of pressure before scaling up
+    down_consecutive: int = 8     # ticks of idle before draining one
+    cooldown_s: float = 1.0       # min seconds between scaling actions
+    stall_s: float = 30.0         # heartbeat age that declares a replica dead
+    autoscale: bool = True        # pressure/idle decisions (floor is always on)
+
+
+class Autoscaler:
+    """Pure hysteretic scaling policy: feed it one observation per
+    controller tick, get ``"up"`` / ``"down"`` / ``None``.  No I/O, no
+    clocks of its own — fully scriptable in tests."""
+
+    def __init__(self, fc: FleetConfig):
+        self.fc = fc
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale: Optional[float] = None
+
+    def decide(self, *, now: float, queued: int, outstanding: int,
+               serving: int, total: int,
+               ttft_p95: Optional[float] = None) -> Optional[str]:
+        fc = self.fc
+        if total < fc.min_replicas:
+            # The floor is availability, not load policy: no hysteresis,
+            # no cooldown, no autoscale gate — backfill immediately.
+            return "up"
+        if not fc.autoscale:
+            return None
+        pressure = serving > 0 and (
+            queued > fc.up_queue_per_replica * serving
+            or (fc.up_ttft_p95_s is not None and ttft_p95 is not None
+                and ttft_p95 > fc.up_ttft_p95_s)
+        )
+        idle = queued == 0 and outstanding == 0
+        self._up_streak = self._up_streak + 1 if pressure else 0
+        self._down_streak = self._down_streak + 1 if idle else 0
+        in_cooldown = (self._last_scale is not None
+                       and (now - self._last_scale) < fc.cooldown_s)
+        if (self._up_streak >= fc.up_consecutive and total < fc.max_replicas
+                and not in_cooldown):
+            self._up_streak = self._down_streak = 0
+            self._last_scale = now
+            return "up"
+        if (self._down_streak >= fc.down_consecutive
+                and serving > fc.min_replicas and serving > 1
+                and not in_cooldown):
+            self._up_streak = self._down_streak = 0
+            self._last_scale = now
+            return "down"
+        return None
+
+
+class ReplicaHandle:
+    """Controller-side view of one replica thread.  The controller owns
+    the handle; the replica thread only touches its own deques, state,
+    and heartbeat — every field is either single-writer or a thread-safe
+    container."""
+
+    def __init__(self, idx: int, bound_cfg):
+        self.idx = idx                      # 1-based; the chaos fleet key
+        self.component = f"fleet/r{idx}"    # observe.health namespace
+        self.slo_name = f"serve-r{idx}"     # observe.slo namespace
+        self.bound_cfg = bound_cfg          # tdx_config captured at spawn
+        self.thread: Optional[threading.Thread] = None
+        self.engine: Optional[ServeEngine] = None
+        self.state = "launching"
+        self.inbox: "deque[Request]" = deque()
+        self.done: "deque[tuple]" = deque()   # (rid, tokens, final_logits)
+        self.bad: "deque[tuple]" = deque()    # (rid, message) — engine reject
+        self.assigned: set = set()            # rids routed here, not yet done
+        self.stop_evt = threading.Event()
+        self.drain_evt = threading.Event()
+        self.work_evt = threading.Event()
+        self.leftover: List[Request] = []     # drain's unserved backlog
+        self.error: Optional[BaseException] = None
+        self.bring_up_seconds: Optional[float] = None
+        self.bring_up_warm: Optional[bool] = None
+        self.last_beat = time.monotonic()
+        self.reaped = False                   # controller removed it
+
+    def set_state(self, state: str) -> None:
+        """Advance the replica state machine; terminal states stick (a
+        woken hang thread must not resurrect a reaped replica), and a
+        reaped replica no longer mirrors into /readyz."""
+        if self.state in _TERMINAL_STATES:
+            return
+        self.state = state
+        if not self.reaped:
+            observe.health.set_state(self.component, state)
+
+    def give(self, req: Request) -> None:
+        self.assigned.add(req.rid)
+        self.inbox.append(req)
+        self.work_evt.set()
+
+    def outstanding(self) -> int:
+        """Remaining token budget routed at this replica (inbox not yet
+        pulled + the engine's waiting/active lanes)."""
+        load = sum(r.max_new_tokens for r in list(self.inbox))
+        eng = self.engine
+        if eng is not None:
+            load += eng.outstanding_tokens()
+        return load
+
+    def beat(self) -> None:
+        self.last_beat = time.monotonic()
+
+
+class ServeFleet:
+    """The fleet controller; see the module docstring for the design."""
+
+    def __init__(
+        self,
+        model: "str | TransformerConfig" = "tiny",
+        *,
+        family: Optional[str] = None,
+        serve_cfg: Optional[ServeConfig] = None,
+        fleet_cfg: Optional[FleetConfig] = None,
+        mesh=None,
+        plan=None,
+        seed: int = 0,
+        param_dtype=None,
+        sample_len: int = 8,
+        on_token: Optional[Callable[[str, int], None]] = None,
+    ):
+        if isinstance(model, str):
+            cfg = PRESETS[model]
+            if not isinstance(cfg, TransformerConfig):
+                raise ValueError(f"preset {model!r} is not a decoder LM")
+            family = family or model_family(model)
+        else:
+            cfg = model
+            family = family or "llama"
+        self.model, self.family, self.cfg = model, family, cfg
+        self.serve_cfg = serve_cfg
+        self.fc = fleet_cfg or FleetConfig()
+        self.mesh, self.plan = mesh, plan
+        self._seed, self._param_dtype = seed, param_dtype
+        self._sample_len = sample_len
+        self.on_token = on_token
+        # Validation mirror of ServeEngine.submit: an invalid request is
+        # a typed rejection at the DOOR, not a replica-thread crash.
+        self._resolved = (serve_cfg or ServeConfig()).resolve(cfg)
+        self._kvcfg = self._resolved.kv_config(cfg)
+        self.params = None            # first replica's params (oracle use)
+        self.queue = AdmissionQueue(max_depth=self.fc.max_queue)
+        self.autoscaler = Autoscaler(self.fc)
+        self.handles: List[ReplicaHandle] = []       # launch order
+        self.results: Dict[str, List[int]] = {}
+        self.final_logits: Dict[str, np.ndarray] = {}
+        self.rejected: Dict[str, Rejection] = {}
+        self._pending: set = set()            # rids admitted, not yet done
+        self._requests: Dict[str, Request] = {}
+        self._stream_pos: Dict[str, int] = {}  # fleet-level dedupe
+        self._stream_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._next_idx = 1
+        self._tick_no = 0
+        self._shutdown = False
+        self._log = get_logger()
+
+    # -- scaling ------------------------------------------------------------
+
+    def start(self, n: Optional[int] = None, *, wait: bool = True,
+              timeout: float = 300.0) -> "ServeFleet":
+        """Bring up ``n`` replicas (default ``min_replicas``)."""
+        n = self.fc.min_replicas if n is None else n
+        for _ in range(n):
+            self.scale_up()
+        if wait:
+            self.wait_replicas(n, timeout=timeout)
+        return self
+
+    def scale_up(self, *, wait: bool = False,
+                 timeout: float = 300.0) -> ReplicaHandle:
+        """Launch one replica.  The effective ``tdx_config`` (cache dir,
+        registry dir, ...) is captured HERE, on the calling thread, and
+        re-entered on the replica thread via ``tdx_config.bind`` —
+        thread-local ``override`` scopes are invisible to spawned
+        threads, and the registry-warm bring-up contract depends on the
+        replica seeing the caller's registry_dir."""
+        h = ReplicaHandle(self._next_idx, tdx_config.get())
+        self._next_idx += 1
+        self.handles.append(h)
+        h.set_state("launching")
+        observe.counter("tdx.fleet.scale_ups").inc()
+        observe.instant("fleet.scale_up", category="serve", replica=h.idx)
+        h.thread = threading.Thread(
+            target=self._replica_main, args=(h,),
+            name=f"tdx-fleet-r{h.idx}", daemon=True,
+        )
+        h.thread.start()
+        if wait:
+            deadline = time.monotonic() + timeout
+            while h.state not in ("serving",) + _TERMINAL_STATES:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"replica r{h.idx} not serving after {timeout}s "
+                        f"(state={h.state})"
+                    )
+                self._wake.wait(0.005)
+                self._wake.clear()
+            if h.state != "serving":
+                raise RuntimeError(
+                    f"replica r{h.idx} died during bring-up "
+                    f"(state={h.state}): {h.error}"
+                )
+        return h
+
+    def scale_down(self) -> Optional[ReplicaHandle]:
+        """Start draining the least-loaded serving replica: it finishes
+        its in-flight lanes, gets no new work, hands back its unadmitted
+        backlog, and frees its KV pool; the controller requeues the
+        backlog and removes it (:meth:`tick`)."""
+        serving = [h for h in self.handles if h.state == "serving"]
+        if not serving:
+            return None
+        h = least_outstanding(serving, lambda x: x.outstanding())
+        h.set_state("draining")
+        h.drain_evt.set()
+        h.work_evt.set()
+        observe.instant("fleet.scale_down", category="serve", replica=h.idx)
+        return h
+
+    def wait_replicas(self, n: int, *, timeout: float = 300.0) -> None:
+        """Tick until ``n`` replicas are serving (bring-up + backfill)."""
+        deadline = time.monotonic() + timeout
+        while sum(1 for h in self.handles if h.state == "serving") < n:
+            if time.monotonic() > deadline:
+                states = {f"r{h.idx}": h.state for h in self.handles}
+                raise RuntimeError(
+                    f"fewer than {n} replicas serving after {timeout}s: "
+                    f"{states}"
+                )
+            self.tick()
+            self._wake.wait(0.005)
+            self._wake.clear()
+
+    # -- admission ----------------------------------------------------------
+
+    def _validate(self, req: Request) -> Optional[str]:
+        """ServeEngine.submit's checks, mirrored — returns the rejection
+        detail or None."""
+        if not req.tokens:
+            return "empty prompt"
+        if req.max_new_tokens < 1:
+            return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+        need = self._kvcfg.pages_for(len(req.tokens) + 1)
+        if need > self._kvcfg.usable_pages:
+            return (f"prompt of {len(req.tokens)} tokens needs {need} pages "
+                    f"but the pool only has {self._kvcfg.usable_pages}")
+        if len(req.tokens) + req.max_new_tokens > self._resolved.max_context:
+            return (f"prompt + budget ({len(req.tokens)} + "
+                    f"{req.max_new_tokens}) exceeds "
+                    f"max_context={self._resolved.max_context}")
+        if len(req.tokens) > self._resolved.prefill_buckets[-1]:
+            return (f"prompt of {len(req.tokens)} tokens exceeds the largest "
+                    f"prefill bucket {self._resolved.prefill_buckets[-1]}")
+        return None
+
+    def _reject(self, rejection: Rejection) -> None:
+        self.rejected[rejection.rid] = rejection
+        self._pending.discard(rejection.rid)
+        observe.counter("tdx.fleet.rejected_requests",
+                        reason=rejection.reason).inc()
+        observe.instant("fleet.reject", category="serve",
+                        rid=rejection.rid, reason=rejection.reason)
+
+    def submit(self, req: Request, *,
+               deadline_s: Optional[float] = None) -> None:
+        """Admit one request into the global queue.  Raises
+        :class:`~.router.FleetRejected` (``invalid`` / ``queue_full``)
+        — every rejection is also recorded in :attr:`rejected` and
+        counted (``tdx.fleet.rejected_requests``)."""
+        detail = self._validate(req)
+        if detail is not None:
+            rej = Rejection(req.rid, "invalid", detail)
+            self._reject(rej)
+            raise FleetRejected(rej)
+        try:
+            self.queue.push(req, deadline_s=deadline_s)
+        except FleetRejected as e:
+            self._reject(e.rejection)
+            raise
+        self._pending.add(req.rid)
+        self._requests[req.rid] = req
+        req._submit_t = time.perf_counter()
+
+    # -- the controller tick ------------------------------------------------
+
+    def _ttft_p95(self) -> Optional[float]:
+        """Worst per-replica p95 TTFT over the live SLO windows — the
+        autoscaler's latency-pressure signal."""
+        worst = None
+        for h in self.handles:
+            eng = h.engine
+            if eng is None or h.state != "serving":
+                continue
+            p = eng.slo.windows["ttft"].percentiles((95,))
+            if p and (worst is None or p[95] > worst):
+                worst = p[95]
+        return worst
+
+    def tick(self) -> None:
+        """One control step: expire deadlines → reap completions → reap
+        dead/drained replicas (requeue their work) → dispatch → scale.
+        Single-threaded: only the controller thread calls this."""
+        self._tick_no += 1
+        now = time.monotonic()
+        for rej in self.queue.expire(now=now):
+            self._reject(rej)
+        for h in list(self.handles):
+            self._reap_completions(h)
+            if h.state in _DEAD_STATES or (
+                    h.state == "serving"
+                    and (now - h.last_beat) > self.fc.stall_s):
+                self._reap_dead(h)
+            elif h.state == "drained":
+                self._reap_drained(h)
+        self._dispatch()
+        self._autoscale(now)
+        if observe.enabled():
+            observe.gauge("tdx.fleet.replicas").set(len(self.handles))
+            observe.gauge("tdx.fleet.ready_replicas").set(
+                sum(1 for h in self.handles if h.state == "serving"))
+
+    def _reap_completions(self, h: ReplicaHandle) -> None:
+        while h.done:
+            rid, toks, logits = h.done.popleft()
+            h.assigned.discard(rid)
+            if rid in self._pending:      # dedupe: a revived "dead"
+                self._pending.discard(rid)   # replica may double-finish
+                self.results[rid] = toks
+                self.final_logits[rid] = logits
+        while h.bad:
+            rid, msg = h.bad.popleft()
+            h.assigned.discard(rid)
+            if rid in self._pending:
+                self._reject(Rejection(rid, "invalid", msg))
+
+    def _requeue_assigned(self, h: ReplicaHandle, reqs: Sequence[Request],
+                          *, why: str) -> None:
+        for req in reqs:
+            if req.rid not in self._pending:
+                continue  # completed before the replica went away
+            self.queue.requeue(req)
+            h.assigned.discard(req.rid)
+            observe.counter("tdx.fleet.requeued_requests").inc()
+            observe.instant("fleet.requeue", category="serve",
+                            rid=req.rid, replica=h.idx, reason=why)
+
+    def _remove(self, h: ReplicaHandle) -> None:
+        h.reaped = True
+        h.stop_evt.set()
+        h.work_evt.set()
+        self.handles.remove(h)
+        observe.health.clear_state(h.component)
+
+    def _reap_dead(self, h: ReplicaHandle) -> None:
+        """A replica died (chaos raise/preempt, bring-up failure) or
+        stalled (chaos hang past ``stall_s``): requeue everything it
+        held and remove it.  The min-replica floor backfills on the
+        next autoscale pass."""
+        why = h.state if h.state in _DEAD_STATES else "stalled"
+        self._log.warning(
+            "fleet: replica r%d %s (%s); requeueing %d requests",
+            h.idx, why, h.error or "heartbeat stale", len(h.assigned),
+        )
+        observe.instant("fleet.replica_dead", category="serve",
+                        replica=h.idx, reason=why)
+        reqs = [self._requests[rid] for rid in sorted(h.assigned)
+                if rid in self._requests]
+        self._requeue_assigned(h, reqs, why=why)
+        self._remove(h)
+
+    def _reap_drained(self, h: ReplicaHandle) -> None:
+        """A drain finished: its in-flight lanes completed bitwise (they
+        were reaped above), its unserved backlog goes back to the queue
+        front, its KV pool is already freed — remove it."""
+        self._reap_completions(h)  # lanes it finished while draining
+        self._requeue_assigned(h, h.leftover, why="drain")
+        observe.counter("tdx.fleet.scale_downs").inc()
+        self._remove(h)
+
+    def _dispatch(self) -> None:
+        serving = [h for h in self.handles if h.state == "serving"]
+        if not serving:
+            return
+        cap = max(1, int(self._resolved.max_batch
+                         * self.fc.dispatch_per_replica))
+        while True:
+            ready = [h for h in serving if len(h.assigned) < cap]
+            if not ready:
+                return  # backlog stays queued → visible scale pressure
+            entry = self.queue.pop()
+            if entry is None:
+                return
+            h = least_outstanding(ready, lambda x: x.outstanding())
+            h.give(entry.req)
+
+    def _autoscale(self, now: float) -> None:
+        serving = sum(1 for h in self.handles if h.state == "serving")
+        outstanding = sum(h.outstanding() for h in self.handles)
+        decision = self.autoscaler.decide(
+            now=now, queued=self.queue.depth(), outstanding=outstanding,
+            serving=serving, total=len(self.handles),
+            ttft_p95=self._ttft_p95(),
+        )
+        if decision == "up" and len(self.handles) < self.fc.max_replicas:
+            self.scale_up()
+        elif decision == "down":
+            self.scale_down()
+
+    # -- the blocking storm driver ------------------------------------------
+
+    def run(self, requests: Sequence[Request] = (), *,
+            max_seconds: float = 300.0) -> Dict[str, List[int]]:
+        """Submit ``requests`` (``arrival_step`` staggers them by
+        controller tick) and tick until every admitted request completed
+        or was rejected; returns the cumulative rid → tokens map.
+        Requests rejected at the door (``invalid`` / ``queue_full``)
+        are recorded in :attr:`rejected` and skipped, not raised — a
+        storm driver wants the fleet's aggregate behavior."""
+        arrivals = sorted(requests, key=lambda r: r.arrival_step)
+        deadline = time.monotonic() + max_seconds
+        i = 0
+        while True:
+            while i < len(arrivals) and (
+                    arrivals[i].arrival_step <= self._tick_no):
+                try:
+                    self.submit(arrivals[i])
+                except FleetRejected:
+                    pass  # recorded + counted by submit
+                i += 1
+            self.tick()
+            if i >= len(arrivals) and not self._pending:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet run exceeded {max_seconds}s with "
+                    f"{len(self._pending)} pending / {len(arrivals) - i} "
+                    f"unsubmitted"
+                )
+            self._wake.wait(0.002)
+            self._wake.clear()
+        return dict(self.results)
+
+    def shutdown(self) -> None:
+        """Stop every replica thread and clear the fleet's /readyz
+        components; results stay readable."""
+        self._shutdown = True
+        for h in list(self.handles):
+            h.stop_evt.set()
+            h.work_evt.set()
+        for h in list(self.handles):
+            if h.thread is not None:
+                h.thread.join(timeout=10.0)
+            h.reaped = True
+            observe.health.clear_state(h.component)
+        self.handles.clear()
+        if observe.enabled():
+            observe.gauge("tdx.fleet.replicas").set(0)
+            observe.gauge("tdx.fleet.ready_replicas").set(0)
+
+    def __enter__(self) -> "ServeFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- the replica thread -------------------------------------------------
+
+    def _make_on_token(self, h: ReplicaHandle):
+        """Per-replica stream adapter with FLEET-level dedupe: the
+        engine dedupes replayed positions within ONE engine, but a
+        request requeued onto a new replica regenerates from position 1
+        — the client must not hear those positions twice."""
+        counts: Dict[str, int] = {}  # this replica's delivered positions
+        user = self.on_token
+
+        def _on_token(rid: str, token: int) -> None:
+            pos = counts.get(rid, 0) + 1
+            counts[rid] = pos
+            with self._stream_lock:
+                if pos <= self._stream_pos.get(rid, 0):
+                    return  # already streamed by a previous replica
+                self._stream_pos[rid] = pos
+            if user is not None:
+                user(rid, token)
+
+        return _on_token
+
+    def _maybe_fleet_fault(self, h: ReplicaHandle) -> None:
+        """The ``fleet`` chaos site: keyed by replica id, fired from the
+        replica's own thread while it has a batch in flight — OUTSIDE
+        the engine's step-level retry, so a raise kills the REPLICA (and
+        the controller requeues), not just the batch.  Reads the
+        process-wide installed plan (``chaos.install`` /
+        ``TDX_FAULT_PLAN``) — a thread-local ``override(fault_plan=...)``
+        scope is invisible to replica threads anyway."""
+        plan = chaos.active_plan()
+        if plan is None:
+            return
+        for fault in plan.take("fleet", h.idx):
+            chaos.execute_replica_fault(fault)
+
+    def _replica_main(self, h: ReplicaHandle) -> None:
+        chaos.set_cancel_event(h.stop_evt)
+        try:
+            with tdx_config.bind(h.bound_cfg):
+                engine = spin_up_replica(
+                    self.model, family=self.family,
+                    serve_cfg=self.serve_cfg, mesh=self.mesh,
+                    plan=self.plan,
+                    seed=self._seed, param_dtype=self._param_dtype,
+                    sample_len=self._sample_len,
+                    on_token=self._make_on_token(h),
+                    on_complete=lambda rid, toks, logits: (
+                        h.done.append((rid, toks, logits)),
+                        self._wake.set(),
+                    ),
+                    health_component=h.component, slo_name=h.slo_name,
+                )
+                h.engine = engine
+                h.bring_up_seconds = engine.bring_up_seconds
+                h.bring_up_warm = (
+                    "miss" not in set(engine.bring_up_outcomes.values()))
+                if self.params is None:
+                    self.params = engine.params
+                if h.bring_up_warm and observe.enabled():
+                    observe.gauge("tdx.fleet.spin_up_warm_s").set(
+                        round(engine.bring_up_seconds, 3))
+                h.set_state("serving")
+                h.beat()
+                self._wake.set()
+                self._serve_loop(h, engine)
+        except BaseException as e:  # noqa: BLE001 — the death IS the signal
+            h.error = e
+            h.set_state("preempted" if isinstance(e, chaos.ReplicaPreempted)
+                        else "failed")
+        finally:
+            self._wake.set()
+
+    def _serve_loop(self, h: ReplicaHandle, engine: ServeEngine) -> None:
+        while not h.stop_evt.is_set():
+            if h.drain_evt.is_set():
+                leftover = list(h.inbox)     # never admitted; hand back
+                h.inbox.clear()
+                leftover.extend(engine.drain())
+                engine.release_kv()
+                h.leftover = leftover
+                h.set_state("drained")
+                return
+            while h.inbox:
+                req = h.inbox.popleft()
+                req.arrival_step = 0  # fleet ticks ≠ this engine's steps
+                try:
+                    engine.submit(req)
+                except ValueError as e:
+                    h.bad.append((req.rid, str(e)))
+            if engine.active or engine.waiting:
+                if engine.active:
+                    self._maybe_fleet_fault(h)  # mid-batch, by contract
+                engine.step()
+                h.beat()
+                if h.done:
+                    self._wake.set()
+            else:
+                h.beat()
+                h.work_evt.wait(0.002)
+                h.work_evt.clear()
