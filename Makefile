@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke soak-smoke bench-smoke bench-trend lint trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke guardrails-smoke soak-smoke bench-smoke bench-trend lint lint-native trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -15,9 +15,12 @@ native:
 
 native-test-build:
 	mkdir -p csrc/build
-	g++ $(NATIVE_CXXFLAGS) $(SAN) \
+	g++ $(NATIVE_CXXFLAGS) $(SAN) -pthread \
 	    -o csrc/build/test_graph csrc/tdx_graph.cc csrc/test_graph.cc
 
+# Also the TSan lane: `make native-test SAN="-fsanitize=thread"` runs the
+# concurrent record-while-materialize stress in csrc/test_graph.cc under
+# the thread sanitizer (.github/workflows/ci.yaml `sanitize` job).
 native-test: native-test-build
 	./csrc/build/test_graph
 
@@ -47,10 +50,11 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke
+chaos-test: registry-smoke serve-smoke fleet-smoke guardrails-smoke obs-smoke reshard-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py tests/test_fleet.py \
+	    tests/test_guardrails.py \
 	    tests/test_flightrec.py tests/test_materialize_transport.py \
 	    tests/test_live_ops.py tests/test_bench_trend.py \
 	    tests/test_reshard.py \
@@ -81,6 +85,16 @@ serve-smoke:
 # bounded; part of `make chaos-test`.
 fleet-smoke:
 	timeout -k 10 420 bash scripts/fleet_smoke.sh
+
+# Guardrails smoke (docs/serving.md §Guardrails): registry-warm fleet
+# under a permanently flapping replica with every guardrail armed —
+# breaker trip + warm quarantine-and-respawn (zero local compiles),
+# hedged dispatch, typed deadline rejections carrying oracle-prefix
+# tokens, then a brownout shed/door-reject/hysteretic-exit pass — all
+# with completed output equal to the unbatched oracle.  CPU, bounded;
+# part of `make chaos-test`.
+guardrails-smoke:
+	timeout -k 10 420 bash scripts/guardrails_smoke.sh
 
 # Pod-scale registry smoke (docs/registry.md): a 2-process sharded warm
 # against a shared artifact registry — disjoint compile shards verified
@@ -168,13 +182,30 @@ bench-trend:
 # One lint entry point for CI and humans (rule set lives in ruff.toml).
 # Same degrade-to-skip protocol as `docs`: the dev image ships no ruff,
 # CI installs it and fails loudly.
-lint:
+lint: lint-native
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check .; \
 	elif python -c "import ruff" 2>/dev/null; then \
 		python -m ruff check .; \
 	else \
 		echo "lint skipped: ruff not installed (CI runs it)"; \
+	fi
+
+# C++ lint over csrc/ (style: .clang-format, checks: .clang-tidy).  Same
+# degrade-to-skip protocol: the dev image ships no clang tools, CI
+# installs them and fails loudly (ci.yaml `lint` job).
+lint-native:
+	@if command -v clang-format >/dev/null 2>&1; then \
+		clang-format --dry-run --Werror \
+		    csrc/tdx_graph.cc csrc/test_graph.cc csrc/include/tdx_graph.h; \
+	else \
+		echo "clang-format skipped: not installed (CI runs it)"; \
+	fi
+	@if command -v clang-tidy >/dev/null 2>&1; then \
+		clang-tidy csrc/tdx_graph.cc csrc/test_graph.cc -- \
+		    -std=c++17 -pthread; \
+	else \
+		echo "clang-tidy skipped: not installed (CI runs it)"; \
 	fi
 
 # Digest a telemetry trace directory (see docs/observability.md): top

@@ -1690,6 +1690,244 @@ def phase_serving_fleet() -> dict:
     return out
 
 
+def phase_guardrails() -> dict:
+    """Guardrail phase (docs/serving.md §Guardrails): the SAME
+    mixed-priority storm is driven twice through a 2-replica fleet whose
+    replica 2 flaps on seven of every eight batches
+    (``fleet@2=flap:0.875`` — intermittent enough that kill-detection
+    never fires), once with guardrails disarmed and once with the full
+    guardrail set (circuit breaker + quarantine-and-respawn, hedged
+    dispatch, priority brownout).  Disarmed, the flapping replica keeps
+    its share of the queue through endless requeue/replay cycles and the
+    storm's tail queues behind it; armed, the breaker trips within two
+    faults, the replica is ejected and a registry-warm respawn restores
+    capacity, and brownout sheds queued low-priority work.
+    ``guardrails_p95_ttft_improvement`` is the HIGH-priority p95
+    time-to-first-token ratio (disarmed / armed) — the guardrail claim
+    is precisely that faults cost tail latency, and the breaker refunds
+    it.
+
+    Gates (raise ⇒ CI fails, not just a slow number): every completed
+    response equals the unbatched no-cache oracle in BOTH runs, the
+    disarmed run completes the whole storm with zero rejections, the
+    armed run completes every high-priority request and rejects nothing
+    untyped (brownout sheds only), the breaker trips at least once, its
+    respawn is warm with ZERO local compiles fleet-wide, and the armed
+    p95 beats the disarmed one."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+    jax = _virtual_cpu_init(1)
+    import numpy as np
+
+    import jax.numpy as jnp
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import chaos, observe
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        FleetConfig, GuardrailConfig, Request, ServeConfig, ServeFleet,
+        oracle_generate, spin_up_replica, warm_serving,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=96, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=192, max_seq_len=64, dtype=jnp.float32,
+    )
+    scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                       max_pages_per_seq=4, prefill_buckets=(8, 16))
+
+    # Short generations keep each batch inside the flap's clean window
+    # (duty 0.875 fires on 7 of every 8 serve-loop hits and the hit
+    # phase advances one per retry cycle; a requeued lane re-earns
+    # prompt + 2 tokens on its admit step and needs ONE clean decode
+    # step to finish), so the DISARMED run terminates — slowly, after
+    # up to 8 replay cycles per batch — instead of livelocking.  48
+    # requests against max_batch=2 put the pressure where the
+    # guardrails act (the admission queue) and give the p95 24
+    # high-priority samples.
+    def storm(tag):
+        rng = np.random.RandomState(13)
+        return [
+            Request(f"{tag}{i}", [int(t) for t in
+                                  rng.randint(0, cfg.vocab_size,
+                                              size=2 + int(rng.randint(10)))],
+                    max_new_tokens=3, priority=i % 2, arrival_step=0)
+            for i in range(48)
+        ]
+
+    oracle_cache = {}
+
+    def check_oracle(fl, reqs, results):
+        for r in reqs:
+            if r.rid not in results:
+                continue
+            key = (tuple(r.tokens), r.max_new_tokens)
+            if key not in oracle_cache:
+                oracle_cache[key] = oracle_generate(
+                    "llama", cfg, fl.params, r.tokens, r.max_new_tokens)[0]
+            if results[r.rid] != oracle_cache[key]:
+                raise RuntimeError(
+                    f"fleet output diverged from the unbatched oracle "
+                    f"on {r.rid}"
+                )
+
+    def csnap():
+        return {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+
+    def flap_storm(tag, gc):
+        """One storm through a flapping 2-replica fleet; returns the
+        high-priority p95 TTFT plus the facts the gates check."""
+        ttft = {}
+        fl = ServeFleet(cfg, family="llama", serve_cfg=scfg,
+                        fleet_cfg=FleetConfig(min_replicas=2,
+                                              max_replicas=3,
+                                              autoscale=False,
+                                              stall_s=120.0,
+                                              guardrails=gc),
+                        on_token=lambda rid, tok: ttft.setdefault(
+                            rid, time.perf_counter()))
+        with fl:
+            fl.start(2, timeout=240.0)
+            chaos.install("fleet@2=flap:0.875")
+            try:
+                reqs = storm(tag)
+                t0 = time.perf_counter()
+                results = fl.run(reqs, max_seconds=240.0)
+            finally:
+                chaos.clear()
+            check_oracle(fl, reqs, results)
+            facts = {
+                "rejected": {rid: rej.reason
+                             for rid, rej in fl.rejected.items()},
+                # Tri-state per respawn: True warm, False compiled, None
+                # when the storm drained before its bring-up finished
+                # (the fleet-wide zero-local-compile gate still covers
+                # that one).
+                "respawn_warm": [h.bring_up_warm for h in fl.handles
+                                 if h.idx >= 3],
+            }
+        highs = [ttft[r.rid] - t0 for r in reqs
+                 if r.priority == 1 and r.rid in results]
+        if len(highs) < 24:
+            raise RuntimeError(
+                f"{tag}: only {len(highs)}/24 high-priority requests "
+                f"completed: {facts['rejected']}"
+            )
+        return float(np.percentile(highs, 95)), results, facts
+
+    jax.devices()
+    out = {"model_d": cfg.d_model, "n_layers": cfg.n_layers,
+           "storm_requests": 48, "host_cpu_count": os.cpu_count()}
+    reg = tempfile.mkdtemp(prefix="tdx_guard_bench_reg_")
+    caches = []
+
+    def fresh_cache(tag):
+        d = tempfile.mkdtemp(prefix=f"tdx_guard_bench_{tag}_")
+        caches.append(d)
+        return d
+
+    try:
+        # COLD bring-up: what a breaker respawn would cost WITHOUT the
+        # artifact registry (every program XLA-compiled from scratch).
+        mat._reset_cache_binding()
+        with tdx_config.override(cache_dir=fresh_cache("cold")):
+            t0 = time.perf_counter()
+            spin_up_replica(cfg, family="llama", serve_cfg=scfg)
+            out["bring_up_cold_s"] = round(time.perf_counter() - t0, 3)
+
+        # Publish once; both fleets (and the breaker's respawn) bring
+        # replicas up through the registry into one fresh local cache.
+        # clear_caches() between stages for the same reason as the
+        # serving_fleet phase: retained JIT code regions pile up mmap
+        # mappings until vm.max_map_count says ENOMEM.
+        jax.clear_caches()
+        mat._reset_cache_binding()
+        warm_serving("llama", cfg, fresh_cache("pub"), registry_dir=reg,
+                     serve_cfg=scfg)
+        mat._reset_cache_binding()
+        observe.enable(True)
+        base = csnap()
+        fleet_cache = fresh_cache("fleet")
+
+        with tdx_config.override(cache_dir=fleet_cache, registry_dir=reg):
+            # DISARMED: the flapping replica holds its share of the
+            # queue and replays it; the fault may cost (a lot of)
+            # latency, never a token and never a rejection.
+            p95_off, res_off, facts_off = flap_storm("off", None)
+            if facts_off["rejected"]:
+                raise RuntimeError(
+                    f"disarmed storm rejected requests: "
+                    f"{facts_off['rejected']}"
+                )
+            if len(res_off) != 48:
+                raise RuntimeError(
+                    f"disarmed storm incomplete: {len(res_off)}/48"
+                )
+
+            # ARMED: the breaker trips after 2 faults, quarantine backs
+            # off, a registry-warm respawn restores capacity; brownout
+            # may shed queued LOW-priority work (typed) under the
+            # 48-deep burst.  Hedging stays armed but only fires past a
+            # 5 s queue wait.
+            jax.clear_caches()
+            gc = GuardrailConfig(breaker_trip_faults=2,
+                                 breaker_window_s=60.0,
+                                 quarantine_s=0.1, quarantine_max_s=2.0,
+                                 hedging=True, hedge_wait_s=5.0,
+                                 brownout=True)
+            p95_on, res_on, facts_on = flap_storm("on", gc)
+            for rid, reason in facts_on["rejected"].items():
+                if reason != "shed":
+                    raise RuntimeError(
+                        f"armed storm rejection not a brownout shed: "
+                        f"{rid} -> {reason}"
+                    )
+            if not facts_on["respawn_warm"]:
+                raise RuntimeError("the breaker never respawned a replica")
+            if any(w is False for w in facts_on["respawn_warm"]):
+                raise RuntimeError("breaker respawn hit the compiler")
+
+        snap = csnap()
+        out["guardrails_breaker_trips"] = int(
+            snap.get("tdx.fleet.breaker_trips", 0)
+            - base.get("tdx.fleet.breaker_trips", 0))
+        if out["guardrails_breaker_trips"] < 1:
+            raise RuntimeError("the flap storm never tripped the breaker")
+        out["guardrails_hedged"] = int(
+            snap.get("tdx.fleet.hedged_requests", 0)
+            - base.get("tdx.fleet.hedged_requests", 0))
+        out["guardrails_shed_low"] = int(
+            snap.get("tdx.fleet.shed_requests", 0)
+            - base.get("tdx.fleet.shed_requests", 0))
+        miss = (snap.get("tdx.jax.compile_cache_miss", 0)
+                - base.get("tdx.jax.compile_cache_miss", 0))
+        out["warm_local_compiles"] = int(miss)
+        if miss:
+            raise RuntimeError(
+                f"registry-warm fleets paid {int(miss)} local compiles"
+            )
+        out["guardrails_off_p95_ttft_s"] = round(p95_off, 3)
+        out["guardrails_on_p95_ttft_s"] = round(p95_on, 3)
+        out["guardrails_p95_ttft_improvement"] = round(p95_off / p95_on, 3)
+        if out["guardrails_p95_ttft_improvement"] <= 1:
+            raise RuntimeError(
+                f"guardrails did not improve high-priority p95 TTFT: "
+                f"disarmed {p95_off:.3f}s vs armed {p95_on:.3f}s"
+            )
+        out["oracle_equal"] = True
+    finally:
+        observe.enable(None)
+        mat._reset_cache_binding()
+        shutil.rmtree(reg, ignore_errors=True)
+        for d in caches:
+            shutil.rmtree(d, ignore_errors=True)
+    out["backend"] = "cpu"
+    return out
+
+
 def phase_pp_bubble() -> dict:
     """STATIC schedule analysis (no hardware, no wall clocks — tick
     counts and buffer sizes are properties of the schedule tables, so
@@ -2038,6 +2276,7 @@ PHASES = {
     "schedule_measured": phase_schedule_measured,
     "serving": phase_serving,
     "serving_fleet": phase_serving_fleet,
+    "guardrails": phase_guardrails,
     "train_mfu": phase_train_mfu,
     "materialize_pipeline": phase_materialize_pipeline,
     "materialize_bandwidth": phase_materialize_bandwidth,
@@ -2651,6 +2890,18 @@ def main() -> None:
     else:
         out["serving_fleet_error"] = sf["error"][-160:]
 
+    gr = _run_phase("guardrails", timeout=900.0)
+    gr.pop("_backend", None)  # forced-CPU guardrail A/B: cpu by design
+    if "error" not in gr:
+        out["guardrails"] = gr
+        # Promoted headline key: high-priority p95 TTFT under the same
+        # flap storm, guardrails disarmed / armed.
+        if gr.get("guardrails_p95_ttft_improvement") is not None:
+            out["guardrails_p95_ttft_improvement"] = (
+                gr["guardrails_p95_ttft_improvement"])
+    else:
+        out["guardrails_error"] = gr["error"][-160:]
+
     if not fallback:
         for name in ("flash", "flash_bwd", "flash_bias"):
             r = _run_phase(name, timeout=900.0, cache_fallback=True)
@@ -2691,6 +2942,7 @@ _HEADLINE_KEYS = (
     "materialize_bandwidth_gbps", "materialize_bandwidth_utilization",
     "reshard_gbps", "reshard_bytes_moved",
     "fleet_scaleup_warm_speedup", "fleet_scaling_efficiency_2r",
+    "guardrails_p95_ttft_improvement",
     "train_mfu", "train_mfu_xla", "train_tokens_per_s", "train_step_ms",
     "train_stale_s", "train_mfu_skipped", "train_mfu_error",
     "flash_mfu", "flash_speedup", "flash_bwd_mfu", "flash_bwd_speedup",

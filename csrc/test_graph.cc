@@ -1,12 +1,14 @@
-// Native unit tests for the graph engine (run under ASan/UBSan in CI —
-// the runtime sanitizer coverage the reference lacked, its tests/cc was
-// an acknowledged TODO, reference CMakeLists.txt:104-106).
+// Native unit tests for the graph engine (run under ASan/UBSan and TSan
+// in CI — the runtime sanitizer coverage the reference lacked, its
+// tests/cc was an acknowledged TODO, reference CMakeLists.txt:104-106).
 //
 // Build/run: make native-test
 
+#include <atomic>
 #include <cassert>
 #include <cstdint>
 #include <cstdio>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -26,6 +28,82 @@ static std::vector<uint64_t> stack_of(void* g, uint64_t id) {
   uint64_t n = tdx_build_call_stack(g, id, buf, 64);
   assert(n <= 64);
   return std::vector<uint64_t>(buf, buf + n);
+}
+
+// Concurrency stress: recorder threads append alias chains (create /
+// add_storage / add_dep / set_materialized / destroy) while materializer
+// threads walk last-in-place and call stacks over whatever ids have been
+// published — the exact interleaving the reference guards with its graph
+// mutex (deferred_init.cc:1106-1129: recording on one thread while
+// materializing on another).  Every C API call locks the graph's mutex,
+// so `make native-test SAN="-fsanitize=thread"` must come back green;
+// that TSan lane is the contract this test exists to keep.
+static void stress_record_while_materializing() {
+  void* g = tdx_graph_create();
+  constexpr int kRecorders = 4;
+  constexpr int kMaterializers = 3;
+  constexpr int kOps = 1200;
+  std::atomic<uint64_t> max_id{0};
+  std::atomic<bool> recording{true};
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kRecorders; ++t) {
+    threads.emplace_back([&, t] {
+      uint64_t prev = 0;
+      for (int i = 0; i < kOps; ++i) {
+        uint64_t id = tdx_node_create(g);
+        // Storage keys cycle over a small shared set so materializer
+        // walks cross alias boundaries authored by other threads.
+        tdx_node_add_storage(g, id,
+                             0x100 + static_cast<uint64_t>((t + i) % 4));
+        if (prev) tdx_node_add_dep(g, id, prev, 0);
+        if (i % 7 == 3) tdx_node_set_materialized(g, id, 1);
+        if (i % 11 == 5 && prev) {
+          tdx_node_destroy(g, prev);
+          prev = 0;
+        } else {
+          prev = id;
+        }
+        uint64_t cur = max_id.load(std::memory_order_relaxed);
+        while (id > cur && !max_id.compare_exchange_weak(
+                               cur, id, std::memory_order_relaxed)) {
+        }
+      }
+    });
+  }
+  for (int t = 0; t < kMaterializers; ++t) {
+    threads.emplace_back([&, t] {
+      uint64_t buf[256];
+      uint64_t probe = static_cast<uint64_t>(t) + 1;
+      while (recording.load(std::memory_order_relaxed)) {
+        uint64_t hi = max_id.load(std::memory_order_relaxed);
+        if (hi == 0) continue;
+        probe = probe * 2654435761ull + 1;  // cheap deterministic hash walk
+        uint64_t id = 1 + probe % hi;
+        tdx_last_in_place(g, id);  // 0 (destroyed) or a live node id
+        uint64_t n = tdx_build_call_stack(g, id, buf, 256);
+        if (n > 0 && n <= 256) {
+          // Chronological order == ascending ids (op_nr tracks next_id),
+          // even for stacks snapshotted mid-recording.
+          for (uint64_t k = 1; k < n; ++k) assert(buf[k - 1] < buf[k]);
+        }
+      }
+    });
+  }
+  for (int t = 0; t < kRecorders; ++t) threads[t].join();
+  recording.store(false);
+  for (size_t t = kRecorders; t < threads.size(); ++t) threads[t].join();
+
+  // The graph must still answer exact queries after the storm.
+  uint64_t b1 = tdx_node_create(g);
+  uint64_t b2 = tdx_node_create(g);
+  tdx_node_add_storage(g, b1, 0xBEEF);
+  tdx_node_add_storage(g, b2, 0xBEEF);
+  tdx_node_add_dep(g, b2, b1, 0);
+  assert(tdx_last_in_place(g, b1) == b2);
+  auto s = stack_of(g, b2);
+  assert((s == std::vector<uint64_t>{b1, b2}));
+  tdx_graph_destroy(g);
 }
 
 int main() {
@@ -98,6 +176,9 @@ int main() {
   assert(need == 3);
 
   tdx_graph_destroy(g);
+
+  stress_record_while_materializing();
+
   std::puts("native graph tests OK");
   return 0;
 }

@@ -1,0 +1,170 @@
+#!/usr/bin/env bash
+# Guardrails smoke (make guardrails-smoke, docs/serving.md §Guardrails):
+# warm a replica shape's serving program set into a shared artifact
+# registry, then in a FRESH process with an EMPTY local TDX_CACHE_DIR
+# bring up a 2-replica fleet with every guardrail armed and drive a
+# mixed-priority storm through a permanently flapping replica
+# (fleet@2=flap:1.0 — the intermittent fault kill-detection never sees).
+# The breaker must trip and eject it, the registry-warm respawn must pay
+# ZERO local compiles, deadlined dispatches must hedge, and the
+# guardrail invariant must hold: every completed request bitwise-equal
+# to the unbatched oracle, every other one exactly one typed rejection
+# (deadline rejections carrying an oracle-prefix of delivered tokens),
+# no KV page leaked.  A second 1-replica fleet then exercises brownout:
+# queued low-priority work shed, new low-priority work door-rejected,
+# high-priority output oracle-exact, hysteretic exit.  CPU-only,
+# bounded; the in-process equivalents live in tests/test_guardrails.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TDX_CACHE_MIN_COMPILE_S=0
+
+TMP=$(mktemp -d /tmp/tdx_guardrails_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+REG="$TMP/registry"
+
+echo "== decode-program warm: init + prefill buckets + decode published =="
+python tools/warm_cache.py --decode --model tiny --cache-dir "$TMP/warm" \
+    --registry-dir "$REG" --serve-batch 2 --page-size 8 --pages 32 \
+    --max-pages-per-seq 4 --prefill-buckets 8,16 \
+    > "$TMP/warm.json" 2> "$TMP/warm.log"
+grep '^warm:' "$TMP/warm.log" | sed 's/^/  /'
+
+echo "== fresh-process fleet: flap storm under full guardrails =="
+TDX_CACHE_DIR="$TMP/fresh" TDX_REGISTRY_DIR="$REG" python - <<'EOF'
+import numpy as np
+
+from torchdistx_tpu import chaos, observe
+from torchdistx_tpu.serve import (
+    FleetConfig, FleetRejected, GuardrailConfig, Request, ServeConfig,
+    ServeFleet, oracle_generate,
+)
+from torchdistx_tpu.serve.router import REJECT_REASONS
+
+observe.enable(True)
+
+
+def csnap():
+    return {r["name"]: r["value"] for r in observe.counters().snapshot()
+            if r["type"] == "counter"}
+
+
+scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                   max_pages_per_seq=4, prefill_buckets=(8, 16))
+gc = GuardrailConfig(breaker_trip_faults=2, breaker_window_s=60.0,
+                     quarantine_s=0.05, quarantine_max_s=1.0,
+                     hedging=True, hedge_wait_frac=0.0,
+                     brownout=True, brownout_queue_per_replica=50.0)
+fl = ServeFleet("tiny", serve_cfg=scfg,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=3,
+                                      autoscale=False, stall_s=60.0,
+                                      guardrails=gc))
+fl.start(2, timeout=240.0)
+snap = csnap()
+assert snap.get("tdx.jax.compile_cache_miss", 0) == 0, (
+    f"bring-up paid local compiles: "
+    f"{[h.engine.bring_up_outcomes for h in fl.handles]}")
+assert all(h.bring_up_warm for h in fl.handles)
+print("  bring-up: 2 replicas warm, 0 local compiles")
+
+# Replica 2 flaps on EVERY batch it serves; the respawns (idx >= 3)
+# never match the plan's replica key, so recovery sticks.
+chaos.install("fleet@2=flap:1.0")
+try:
+    rng = np.random.RandomState(23)
+    reqs = []
+    for i in range(10):
+        prompt = [int(t) for t in
+                  rng.randint(0, 256, size=1 + int(rng.randint(10)))]
+        reqs.append(Request(
+            f"g{i}", prompt, max_new_tokens=2 + int(rng.randint(5)),
+            priority=i % 2,
+            deadline_s=(0.01 if i == 4 else 60.0 if i % 3 == 0 else None),
+            arrival_step=i,
+        ))
+    out = fl.run(reqs, max_seconds=240.0)
+finally:
+    chaos.clear()
+
+n_done = n_rej = 0
+for r in reqs:
+    if r.rid in out:
+        assert r.rid not in fl.rejected, r.rid
+        want, want_logits = oracle_generate(
+            fl.family, fl.cfg, fl.params, r.tokens, r.max_new_tokens)
+        assert out[r.rid] == want, (r.rid, out[r.rid], want)
+        np.testing.assert_allclose(fl.final_logits[r.rid], want_logits,
+                                   atol=1e-4)
+        n_done += 1
+    else:
+        rej = fl.rejected[r.rid]  # exactly one, typed
+        assert rej.reason in REJECT_REASONS, rej
+        if rej.reason == "deadline" and rej.tokens:
+            want, _ = oracle_generate(fl.family, fl.cfg, fl.params,
+                                      r.tokens, r.max_new_tokens)
+            assert list(rej.tokens) == want[:len(rej.tokens)], rej
+        n_rej += 1
+snap = csnap()
+assert snap.get("tdx.fleet.breaker_trips", 0) >= 1, snap
+assert snap.get("tdx.fleet.hedged_requests", 0) >= 1, snap
+assert snap.get("tdx.jax.compile_cache_miss", 0) == 0, (
+    "breaker respawn paid a local compile")
+for h in fl.handles:
+    if h.engine is not None and h.engine.k_pages is not None:
+        assert h.engine.kv.pages_in_use == 0, h.idx
+assert not fl.partial and not fl._hedges
+fl.shutdown()
+print(f"  OK: {n_done} responses == oracle + {n_rej} typed rejections "
+      f"through a flapping replica "
+      f"({int(snap['tdx.fleet.breaker_trips'])} breaker trips, "
+      f"{int(snap['tdx.fleet.hedged_requests'])} hedged, warm respawn)")
+
+# Brownout: a 1-replica fleet under an 8-deep burst sheds queued lows,
+# door-rejects new lows, serves highs oracle-exact, exits on hysteresis.
+gc2 = GuardrailConfig(breaker=False, hedging=False,
+                      brownout_queue_per_replica=2.0,
+                      brownout_enter_consecutive=1,
+                      brownout_exit_consecutive=2, brownout_priority=1)
+fl2 = ServeFleet("tiny", serve_cfg=scfg,
+                 fleet_cfg=FleetConfig(min_replicas=1, max_replicas=1,
+                                       autoscale=False, stall_s=60.0,
+                                       guardrails=gc2))
+fl2.start(1, timeout=240.0)
+base = csnap()
+highs = [Request(f"hi{i}", [3 + i, 7], max_new_tokens=3, priority=1)
+         for i in range(4)]
+lows = [Request(f"lo{i}", [9 + i, 2], max_new_tokens=3, priority=0)
+        for i in range(4)]
+for r in lows + highs:
+    fl2.submit(r)
+fl2.tick()
+assert fl2.brownout.active
+for r in lows:
+    assert fl2.rejected[r.rid].reason == "shed", r.rid
+try:
+    fl2.submit(Request("door", [1, 2], max_new_tokens=2, priority=0))
+    raise SystemExit("door submit not rejected during brownout")
+except FleetRejected as e:
+    assert e.rejection.reason == "shed", e.rejection
+out = fl2.run(max_seconds=240.0)
+assert set(out) == {r.rid for r in highs}
+for r in highs:
+    want, _ = oracle_generate(fl2.family, fl2.cfg, fl2.params,
+                              r.tokens, r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, out[r.rid], want)
+fl2.tick()
+fl2.tick()
+assert not fl2.brownout.active  # hysteretic exit once pressure cleared
+snap = csnap()
+shed = snap.get("tdx.fleet.shed_requests", 0) - base.get(
+    "tdx.fleet.shed_requests", 0)
+assert shed == 5, shed  # 4 queued + 1 door
+assert snap.get("tdx.fleet.brownouts", 0) - base.get(
+    "tdx.fleet.brownouts", 0) == 1
+fl2.shutdown()
+print(f"  OK: brownout shed {shed} low-priority, highs == oracle, "
+      f"hysteretic exit")
+EOF
+
+echo "guardrails-smoke OK"

@@ -93,6 +93,14 @@ _HOST_PHASES = {
         "fleet_scaling_efficiency_2r": 1.176, "chaos_requeued": 4,
         "warm_local_compiles": 0, "oracle_equal": True,
         "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
+    "guardrails": {
+        "storm_requests": 48, "bring_up_cold_s": 4.2,
+        "guardrails_breaker_trips": 1, "guardrails_hedged": 0,
+        "guardrails_shed_low": 20, "warm_local_compiles": 0,
+        "guardrails_off_p95_ttft_s": 0.247,
+        "guardrails_on_p95_ttft_s": 0.134,
+        "guardrails_p95_ttft_improvement": 1.848, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "schedule_measured": {"schedule_measured": {
         "gpipe_step_ms": 1769.0, "flat_1f1b_step_ms": 2509.0,
         "interleaved_step_ms": 2078.0, "interleaved_vs_flat_measured": 1.208,
@@ -161,6 +169,8 @@ def test_healthy_branch_headline_and_detail(bench):
     assert headline["fleet_scaleup_warm_speedup"] == 5.26
     assert headline["fleet_scaling_efficiency_2r"] == 1.176
     assert full["serving_fleet"]["chaos_requeued"] == 4
+    assert headline["guardrails_p95_ttft_improvement"] == 1.848
+    assert full["guardrails"]["guardrails_breaker_trips"] == 1
     assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208

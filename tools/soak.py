@@ -70,6 +70,21 @@ churn must never change a token:
     python tools/soak.py --modes fleet --seconds 300 \\
         --fault-plan 'fleet@2=raise'
 
+The ``guardrails`` mode soaks the guardrail layer on top of the fleet
+(docs/serving.md §Guardrails): each seed arms every guardrail (circuit
+breakers with quarantine-and-respawn, end-to-end deadlines with
+mid-decode cancellation, hedged dispatch, priority brownout), drives a
+randomized mixed-priority storm — some requests carrying generous
+deadlines, some hopeless ones — through a fleet with a flapping replica
+(the intermittent-fault mode kill-detection never catches), and asserts
+the guardrail invariant: every request either completes bitwise-equal
+to the unbatched oracle or carries exactly one typed rejection
+(``deadline`` rejections' delivered tokens must be an oracle prefix),
+with no KV page leaked and no hedge left unsettled:
+
+    python tools/soak.py --modes guardrails --seconds 300 \\
+        --fault-plan 'fleet@2=flap:0.6'
+
 The ``reshard`` mode soaks the topology-migrating checkpoint
 redistributor (docs/robustness.md §Resharding): each seed saves a
 randomized state, rechunk-copies it through a randomized pair of
@@ -100,7 +115,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MODES = ("whole", "single", "bridge", "bridge_single", "serialize",
          "geom", "geom_single", "geom_bridge", "elastic", "materialize",
-         "registry", "serve", "fleet", "reshard")
+         "registry", "serve", "fleet", "guardrails", "reshard")
 
 _FAULT_PLAN: "str | None" = None  # --fault-plan, set per worker via initargs
 
@@ -718,6 +733,154 @@ def _fleet_oracle(seed: int, plan_text: "str | None"):
     return None
 
 
+def _guardrails_oracle(seed: int, plan_text: "str | None"):
+    """One guardrail-invariant run: a randomized mixed-priority storm —
+    deadlines generous and hopeless, a flapping replica — through a
+    fleet with every guardrail armed (breaker + quarantine, mid-decode
+    deadline cancellation, hedged dispatch, brownout).  The invariant
+    (docs/serving.md §Guardrails): every request either completes
+    bitwise-equal to the unbatched oracle or carries exactly one typed
+    rejection; ``deadline`` rejections' delivered tokens are an oracle
+    prefix; no KV page leaks; no hedge stays unsettled."""
+    import random
+    import shutil
+    import tempfile
+
+    from torchdistx_tpu import chaos
+    from torchdistx_tpu import config as tdx_config
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        FleetConfig,
+        GuardrailConfig,
+        Request,
+        ServeConfig,
+        ServeFleet,
+        oracle_generate,
+        serve_program_specs,
+    )
+    from torchdistx_tpu.serve.programs import compile_serving_program
+    from torchdistx_tpu.serve.router import REJECT_REASONS
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = random.Random(seed)
+    cfg = TransformerConfig(
+        vocab_size=rng.choice([96, 128]),
+        d_model=rng.choice([32, 48]),
+        n_layers=rng.randrange(1, 3),
+        n_heads=4,
+        n_kv_heads=rng.choice([2, 4]),
+        d_ff=64,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    scfg = ServeConfig(
+        max_batch=rng.randrange(2, 4),
+        page_size=rng.choice([4, 8]),
+        n_pages=rng.randrange(10, 16),
+        max_pages_per_seq=4,
+        prefill_buckets=(8,),
+    )
+    resolved = scfg.resolve(cfg)
+    family = "llama"
+    specs = serve_program_specs(family, cfg, scfg, seed=seed % 7)
+    init = specs[0]
+    compiled, _ = compile_serving_program(init)
+    params = jax.tree.unflatten(init.treedef, list(compiled()))
+
+    n_req = rng.randrange(5, 9)
+    reqs = []
+    for i in range(n_req):
+        prompt = [rng.randrange(cfg.vocab_size) for _ in
+                  range(rng.randrange(1, 8))]
+        budget = rng.randrange(1, 1 + min(
+            8, resolved.max_context - len(prompt)))
+        # Mostly deadline-less or generous; an occasional hopeless
+        # deadline must resolve as a typed rejection, never a hang.
+        roll = rng.random()
+        deadline = (None if roll < 0.5 else
+                    60.0 if roll < 0.9 else 0.02)
+        reqs.append(Request(
+            f"r{i}", prompt, max_new_tokens=budget,
+            priority=rng.randrange(0, 2), deadline_s=deadline,
+            arrival_step=rng.randrange(0, 5),
+        ))
+
+    if plan_text:
+        plan = chaos.parse_plan(plan_text)
+    else:
+        duty = rng.choice([0.3, 0.5, 0.6, 0.8])
+        plan = chaos.parse_plan(f"fleet@{rng.randrange(1, 3)}=flap:{duty}")
+
+    gc = GuardrailConfig(
+        breaker_trip_faults=rng.randrange(2, 5), breaker_window_s=60.0,
+        quarantine_s=0.1, quarantine_max_s=2.0,
+        hedging=True, hedge_wait_frac=0.9,
+        brownout=True, brownout_queue_per_replica=50.0,
+    )
+    fc = FleetConfig(min_replicas=2, max_replicas=3, autoscale=False,
+                     stall_s=60.0, guardrails=gc)
+    cache = tempfile.mkdtemp(prefix="tdx_soak_guard_")
+    chaos.install(plan)
+    old_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ["TDX_CACHE_MIN_COMPILE_S"] = "0"
+    try:
+        with tdx_config.override(cache_dir=cache):
+            with ServeFleet(cfg, family=family, serve_cfg=scfg,
+                            seed=seed % 7, fleet_cfg=fc) as fl:
+                fl.start(2, timeout=240.0)
+                out = fl.run(reqs, max_seconds=240.0)
+                rejected = dict(fl.rejected)
+                leaked = [
+                    h.idx for h in fl.handles
+                    if h.engine is not None and h.engine.k_pages is not None
+                    and h.engine.kv.pages_in_use != 0
+                ]
+                unsettled = bool(fl.partial) or bool(fl._hedges)
+    finally:
+        chaos.clear()
+        mat._reset_cache_binding()
+        if old_min is None:
+            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["TDX_CACHE_MIN_COMPILE_S"] = old_min
+        shutil.rmtree(cache, ignore_errors=True)
+    for r in reqs:
+        if r.rid in out:
+            if r.rid in rejected:
+                return ("mismatch",
+                        f"{r.rid} both completed and rejected "
+                        f"({rejected[r.rid]!r}) plan={plan!r}")
+            want, _ = oracle_generate(family, cfg, params, r.tokens,
+                                      r.max_new_tokens, r.eos_id)
+            if out[r.rid] != want:
+                return ("mismatch",
+                        f"{r.rid}: fleet={out[r.rid]} oracle={want} "
+                        f"plan={plan!r}")
+        elif r.rid in rejected:
+            rej = rejected[r.rid]
+            if rej.reason not in REJECT_REASONS:
+                return ("mismatch", f"{r.rid}: untyped rejection {rej!r}")
+            if rej.reason == "deadline" and rej.tokens:
+                want, _ = oracle_generate(family, cfg, params, r.tokens,
+                                          r.max_new_tokens, r.eos_id)
+                if list(rej.tokens) != want[:len(rej.tokens)]:
+                    return ("mismatch",
+                            f"{r.rid}: delivered tokens {rej.tokens} not an "
+                            f"oracle prefix of {want} plan={plan!r}")
+        else:
+            return ("mismatch",
+                    f"{r.rid} neither completed nor rejected plan={plan!r}")
+    if leaked:
+        return ("mismatch", f"KV pages leaked on replicas {leaked} "
+                            f"plan={plan!r}")
+    if unsettled:
+        return ("mismatch", f"unsettled hedge/partial state plan={plan!r}")
+    return None
+
+
 def _run_seed(mode: str, seed: int):
     """Run one oracle; returns None on pass/skip, (kind, message) else."""
     import random
@@ -783,6 +946,10 @@ def _run_seed(mode: str, seed: int):
             r = _fleet_oracle(seed, _FAULT_PLAN)
             if r is not None:
                 return r
+        elif mode == "guardrails":
+            r = _guardrails_oracle(seed, _FAULT_PLAN)
+            if r is not None:
+                return r
         elif mode == "reshard":
             r = _reshard_oracle(seed, _FAULT_PLAN)
             if r is not None:
@@ -826,7 +993,7 @@ def main() -> int:
                                                   "soak_failures.jsonl"))
     ap.add_argument("--fault-plan", default=None,
                     help="chaos plan for --modes elastic/materialize/"
-                         "registry/serve/fleet/reshard (grammar: "
+                         "registry/serve/fleet/guardrails/reshard (grammar: "
                          "torchdistx_tpu.chaos / docs/robustness.md); "
                          "default: a seeded-random plan per seed")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",

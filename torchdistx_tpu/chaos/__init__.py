@@ -40,6 +40,13 @@ Fault kinds and what they model:
 ``preempt``  SIGTERM to self — the *announced* preemption notice; at the
              ``fleet`` site it kills only the replica THREAD
              (:class:`ReplicaPreempted`), modeling replica loss
+``flap``     an INTERMITTENT, RECURRING ``raise`` — the flaky host that
+             faults on a duty-cycle fraction of its matches
+             (deterministic pattern, never spent; arg = duty cycle in
+             ``(0, 1]``, default 0.5).  At the ``fleet`` site the
+             replica survives each fault (its batch requeues) so the
+             fault keeps recurring — the workload the per-replica
+             circuit breaker (docs/serving.md §Guardrails) trips on
 ===========  ==========================================================
 
 The materialization sites fire inside the record→compile→materialize

@@ -102,6 +102,15 @@ def execute(fault: Fault, *, path: Optional[str] = None) -> None:
 
     if fault.kind == "raise":
         raise _xla_runtime_error(f"chaos: injected device failure ({fault.spec()})")
+    if fault.kind == "flap":
+        # The flaky-host model: same constructible XlaRuntimeError as
+        # `raise`, but the PLAN keeps the entry live (never spent) and
+        # fires it on its duty-cycle pattern — recovery code sees the
+        # same failure recur at the same site, which is the signature a
+        # circuit breaker (serve/guardrails.py) exists to catch.
+        raise _xla_runtime_error(
+            f"chaos: injected intermittent fault ({fault.spec()})"
+        )
     if fault.kind == "hang":
         _interruptible_sleep(float(fault.arg) if fault.arg else _HANG_DEFAULT_S)
         return

@@ -17,7 +17,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
            | 'serve'                                (serving engine)
            | 'fleet'                                (fleet replica)
            | 'reshard'                              (checkpoint reshard)
-    kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt'
+    kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt' | 'flap'
 
 Examples::
 
@@ -40,10 +40,28 @@ Examples::
     reshard@2=corrupt:flip       # bit-flip the 2nd in-flight transfer
                                  # chunk of a checkpoint reshard (caught
                                  # by the bitwise verify stage)
+    fleet@2=flap:0.3             # replica 2 FLAPS: an intermittent,
+                                 # recurring fault that fires on 30% of
+                                 # its matches (deterministic pattern,
+                                 # never spent) — the circuit-breaker
+                                 # workload (docs/serving.md §Guardrails)
 
 Each entry fires ``count`` times (default 1) and is then spent — a
 restarted step re-executes fault-free, which is what makes
-recover-and-converge scenarios terminate.  ``corrupt`` args are
+recover-and-converge scenarios terminate.  The one exception is
+``flap``: an INTERMITTENT, RECURRING fault (the flaky-host model a
+circuit breaker must catch, docs/serving.md §Guardrails).  Its arg is a
+duty cycle in ``(0, 1]`` (default 0.5): each time its ``(site, step)``
+matches, the entry counts the match and fires on the deterministic
+Bresenham pattern that realizes exactly that fraction of matches
+(``flap:1.0`` fires every match, ``flap:0.25`` every 4th) — it is
+never spent, ignores ``xN``, and keeps flapping until the plan is
+cleared, so ``pending()`` reports a plan with a flap entry as live
+forever.  ``flap`` raises the same constructible ``XlaRuntimeError``
+as ``raise`` and works at every site; at the ``fleet`` site the
+replica SURVIVES it (its batch requeues, recompute-preemption style)
+so the fault recurs on the same replica — exactly the signature the
+per-replica breaker trips on.  ``corrupt`` args are
 ``truncate`` (default) or ``flip``; ``hang``/``slow`` args are seconds.
 At the materialization sites ``corrupt`` damages the persistent XLA
 compile-cache entries on disk (the bad-cache-entry model) and the
@@ -81,7 +99,8 @@ from typing import List, Optional
 
 SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
          "registry", "serve", "fleet", "reshard")
-KINDS = ("raise", "hang", "corrupt", "slow", "preempt")
+KINDS = ("raise", "hang", "corrupt", "slow", "preempt", "flap")
+_FLAP_DEFAULT_DUTY = 0.5
 
 _ENTRY_RE = re.compile(
     r"^(?P<site>[a-z_]+)@(?P<step>\d+)=(?P<kind>[a-z_]+)"
@@ -99,6 +118,7 @@ class Fault:
     arg: Optional[str] = None
     count: int = 1
     remaining: int = field(default=-1)  # initialized from count
+    hits: int = field(default=0)        # flap: (site, step) matches seen
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -107,8 +127,18 @@ class Fault:
             raise ValueError(f"unknown fault kind {self.kind!r} (one of {KINDS})")
         if self.count < 1:
             raise ValueError(f"fault count must be >= 1, got {self.count}")
+        if self.kind == "flap":
+            duty = self.duty_cycle()
+            if not (0.0 < duty <= 1.0):
+                raise ValueError(
+                    f"flap duty cycle must be in (0, 1], got {duty}"
+                )
         if self.remaining < 0:
             self.remaining = self.count
+
+    def duty_cycle(self) -> float:
+        """The flap entry's firing fraction (its parsed arg)."""
+        return float(self.arg) if self.arg else _FLAP_DEFAULT_DUTY
 
     def spec(self) -> str:
         arg = f":{self.arg}" if self.arg else ""
@@ -135,11 +165,22 @@ class FaultPlan:
         return self
 
     def take(self, site: str, step: int) -> List[Fault]:
-        """Faults due now; their ``remaining`` budgets are consumed."""
+        """Faults due now; their ``remaining`` budgets are consumed.
+        ``flap`` entries are never consumed: each match increments their
+        ``hits`` and they fire on the deterministic Bresenham pattern of
+        their duty cycle — the recurring-intermittent-fault model."""
         out: List[Fault] = []
         with self._lock:
             for f in self.faults:
-                if f.site == site and f.step == step and f.remaining > 0:
+                if f.site != site or f.step != step:
+                    continue
+                if f.kind == "flap":
+                    f.hits += 1
+                    duty = f.duty_cycle()
+                    if int(f.hits * duty) > int((f.hits - 1) * duty):
+                        self.fired.append(f.spec())
+                        out.append(f)
+                elif f.remaining > 0:
                     f.remaining -= 1
                     self.fired.append(f.spec())
                     out.append(f)
@@ -147,7 +188,8 @@ class FaultPlan:
 
     def pending(self) -> List[Fault]:
         with self._lock:
-            return [f for f in self.faults if f.remaining > 0]
+            return [f for f in self.faults
+                    if f.remaining > 0 or f.kind == "flap"]
 
     def __bool__(self) -> bool:  # "is there anything left to inject?"
         return bool(self.pending())

@@ -29,10 +29,25 @@ survivors — same token-exactness contract, fleet-wide::
     with ServeFleet("tiny", fleet_cfg=FleetConfig(min_replicas=2)) as fl:
         fl.start()
         out = fl.run([Request("r0", [1, 2, 3], max_new_tokens=8)])
+
+The guardrail layer (:mod:`.guardrails`, armed via
+``FleetConfig(guardrails=GuardrailConfig(...))``) adds per-replica
+circuit breakers with quarantine + half-open re-admission, end-to-end
+request deadlines with mid-decode lane cancellation, hedged dispatch,
+and priority brownout — every completed request still bitwise-equal to
+the oracle, every non-completed one a typed rejection
+(docs/serving.md §Guardrails).
 """
 
 from .engine import Request, ServeEngine, oracle_generate, spin_up_replica
 from .fleet import Autoscaler, FleetConfig, ReplicaHandle, ServeFleet
+from .guardrails import (
+    Brownout,
+    CircuitBreaker,
+    GuardrailConfig,
+    QuarantineEntry,
+    should_hedge,
+)
 from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
 from .router import (
     AdmissionQueue,
@@ -53,9 +68,13 @@ from .programs import (
 __all__ = [
     "AdmissionQueue",
     "Autoscaler",
+    "Brownout",
+    "CircuitBreaker",
     "FleetConfig",
     "FleetRejected",
+    "GuardrailConfig",
     "KVCacheConfig",
+    "QuarantineEntry",
     "OutOfPages",
     "PagedKVCache",
     "Rejection",
@@ -72,6 +91,7 @@ __all__ = [
     "least_outstanding",
     "oracle_generate",
     "serve_program_specs",
+    "should_hedge",
     "spin_up_replica",
     "warm_serving",
 ]

@@ -79,13 +79,23 @@ __all__ = ["Request", "ServeEngine", "oracle_generate", "spin_up_replica"]
 class Request:
     """One generation request.  ``arrival_step`` simulates staggered
     arrivals for continuous-batching tests and soaks (a request is not
-    admissible before that engine step)."""
+    admissible before that engine step).
+
+    ``deadline_s`` is an END-TO-END deadline, measured from first
+    submission: past it the request is expired while queued AND
+    cancelled mid-decode (its lane's pages freed immediately, the
+    requester handed a typed ``deadline`` rejection carrying
+    tokens-so-far — docs/serving.md §Guardrails).  ``priority`` feeds
+    the fleet's brownout (low-priority work is shed under sustained
+    pressure); the engine itself treats priorities equally."""
 
     rid: str
     tokens: List[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     arrival_step: int = 0
+    deadline_s: Optional[float] = None
+    priority: int = 1
 
 
 @dataclass
@@ -117,6 +127,7 @@ class ServeEngine:
         on_token: Optional[Callable[[str, int], None]] = None,
         on_complete: Optional[Callable[[str, List[int], np.ndarray],
                                        None]] = None,
+        on_cancel: Optional[Callable[[str, List[int], bool], None]] = None,
         slo_name: str = "serve",
     ):
         self.family = family
@@ -127,6 +138,11 @@ class ServeEngine:
         self._seed, self._param_dtype = seed, param_dtype
         self.on_token = on_token
         self.on_complete = on_complete
+        # Deadline-cancellation notifier: (rid, tokens_so_far, was_active)
+        # — was_active distinguishes a cancelled LANE (pages were freed
+        # mid-decode) from an expired waiting request.
+        self.on_cancel = on_cancel
+        self.cancelled: Dict[str, List[int]] = {}  # rid -> tokens at cancel
         self._draining = False
         self.kv = PagedKVCache(self.scfg.kv_config(cfg))
         self.k_pages, self.v_pages = init_pools(self.scfg.kv_config(cfg),
@@ -247,6 +263,12 @@ class ServeEngine:
                 f"{req.max_new_tokens}"
             )
         req._submit_t = time.perf_counter()
+        # The deadline is END-TO-END: anchor it ONCE, at first submit.
+        # A requeued request re-entering a (new) engine keeps its
+        # original deadline — the client has been waiting the whole
+        # time (mirrors the _submit_t queue-wait contract).
+        if req.deadline_s is not None and not hasattr(req, "_deadline_t"):
+            req._deadline_t = req._submit_t + req.deadline_s
         self.waiting.append(req)
         self._gauges()
 
@@ -294,6 +316,75 @@ class ServeEngine:
         finally:
             self._draining = False
 
+    def requeue_active(self, *, reason: str = "fault") -> int:
+        """Preempt every active lane back into ``waiting`` (recompute
+        policy — greedy decode regenerates identically).  The fleet's
+        ``flap`` fault path uses this: an intermittent replica fault
+        costs the batch a replay, not the replica its life.  Returns
+        the number of lanes requeued."""
+        n = len(self.active)
+        for slot in list(self.active):
+            self._preempt(slot, reason=reason)
+        return n
+
+    def cancel(self, rid: str, *, reason: str = "cancel") -> Optional[List[int]]:
+        """Cancel one request mid-flight: an active lane is evicted and
+        its KV pages freed IMMEDIATELY (they go back to the pool this
+        step, not at retirement); a waiting request is simply removed.
+        Returns the tokens generated so far (``[]`` if never admitted),
+        or ``None`` if the engine doesn't hold ``rid``.  Removing a lane
+        between decode steps cannot perturb the survivors: each lane's
+        decode reads only its own slot row and page table, exactly as
+        when a neighbor retires (bitwise-pinned in tests).  Does NOT
+        invoke ``on_cancel`` — the caller initiated this and already
+        knows; only the engine-initiated deadline sweep notifies."""
+        for slot, lane in list(self.active.items()):
+            if lane.req.rid != rid:
+                continue
+            self.active.pop(slot)
+            self.kv.free(lane.seq_id)
+            self._delivered.pop(rid, None)
+            self.cancelled[rid] = list(lane.generated)
+            observe.instant("serve.cancel", category="serve", rid=rid,
+                            reason=reason, step=self._step_no,
+                            tokens=len(lane.generated))
+            self._gauges()
+            return list(lane.generated)
+        for req in list(self.waiting):
+            if req.rid == rid:
+                self.waiting.remove(req)
+                self.cancelled[rid] = []
+                observe.instant("serve.cancel", category="serve", rid=rid,
+                                reason=reason, step=self._step_no, tokens=0)
+                self._gauges()
+                return []
+        return None
+
+    def _expire_deadlines(self) -> None:
+        """The per-decode-tick deadline check: cancel every lane and
+        waiting request past its end-to-end deadline, freeing lane
+        pages immediately, and notify ``on_cancel`` with tokens-so-far
+        — a doomed request must stop burning pool pages the admitted
+        work is starving for (docs/serving.md §Guardrails)."""
+        now = time.perf_counter()
+        doomed = [
+            lane.req.rid for lane in self.active.values()
+            if getattr(lane.req, "_deadline_t", None) is not None
+            and now > lane.req._deadline_t
+        ] + [
+            req.rid for req in self.waiting
+            if getattr(req, "_deadline_t", None) is not None
+            and now > req._deadline_t
+        ]
+        for rid in doomed:
+            was_active = any(lane.req.rid == rid
+                             for lane in self.active.values())
+            toks = self.cancel(rid, reason="deadline")
+            if toks is None:  # pragma: no cover — rid just enumerated
+                continue
+            if self.on_cancel is not None:
+                self.on_cancel(rid, toks, was_active)
+
     def release_kv(self) -> None:
         """Free the replica's KV pool (the end of a drain): drop the
         page tensors and reset the allocator.  The engine can still
@@ -339,6 +430,7 @@ class ServeEngine:
             try:
                 chaos.maybe_inject("serve", self._step_no,
                                    plan=chaos.active_plan())
+                self._expire_deadlines()
                 self._admit()
                 self._decode_step()
             except self._retryable as e:
@@ -596,6 +688,7 @@ def spin_up_replica(
     warm: bool = True,
     on_token=None,
     on_complete=None,
+    on_cancel=None,
     health_component: str = "serve",
     slo_name: str = "serve",
 ) -> ServeEngine:
@@ -663,7 +756,7 @@ def spin_up_replica(
         engine = ServeEngine(
             family, cfg, params, serve_cfg=serve_cfg, mesh=mesh, plan=plan,
             seed=seed, param_dtype=param_dtype, on_token=on_token,
-            on_complete=on_complete, slo_name=slo_name,
+            on_complete=on_complete, on_cancel=on_cancel, slo_name=slo_name,
         )
         # The spec list above already paid the model's deferred-init
         # trace; hand it to the engine so warmup/lazy compiles reuse it.

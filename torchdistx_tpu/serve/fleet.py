@@ -50,6 +50,34 @@ Readiness aggregates: each replica reports ``fleet/rN`` bring-up states
 into :mod:`..observe.health`, and ``/readyz`` returns 200 iff ≥1
 replica is serving, with the per-replica states in the body
 (docs/serving.md §Fleet).
+
+**Guardrails** (docs/serving.md §Guardrails; armed by
+``FleetConfig.guardrails``): the proactive layer on top of the reactive
+fleet.  Per-replica **circuit breakers** (:mod:`.guardrails`) watch a
+sliding fault/hang/slow-tick window — intermittent ``flap`` chaos
+faults leave the replica alive (its batch requeues, and the fault is
+recorded as a breaker observation) so a flaky replica shows the exact
+signature the breaker trips on; a trip ejects the replica (drain if
+responsive, kill if stalled), quarantines it with exponential backoff,
+and re-admits capacity through a HALF-OPEN probe replica that must
+complete one request cleanly before full rotation.  Respawn rides the
+registry-warm bring-up, and the ``min_replicas`` floor counts only
+live (non-quarantined) replicas, so capacity is backfilled during
+quarantine.  **End-to-end deadlines** (``Request.deadline_s``)
+propagate past admission: the dispatcher refuses to dispatch a doomed
+request and the engine cancels a doomed LANE mid-decode
+(:meth:`~.engine.ServeEngine.cancel`), freeing its pages immediately —
+the requester gets a typed ``deadline`` rejection carrying
+tokens-so-far.  **Hedged dispatch**: a request that burned too much of
+its deadline in the queue is dispatched to a second replica; first
+TTFT wins, the loser's lane is cancelled — greedy decode plus the
+fleet-level stream dedupe make hedging invisible in the output
+(bitwise-pinned).  **Priority brownout**: sustained queue/latency
+pressure sheds queued low-priority work (typed ``shed`` rejections)
+and rejects new low-priority work at the door, exiting on hysteresis.
+All four preserve the oracle gate: every request that completes is
+bitwise-equal to ``oracle_generate``; every request that does not
+carries exactly one typed rejection.
 """
 
 from __future__ import annotations
@@ -67,6 +95,13 @@ from .. import config as tdx_config
 from ..models import PRESETS, TransformerConfig
 from ..utils.logging import get_logger
 from .engine import Request, ServeEngine, spin_up_replica
+from .guardrails import (
+    Brownout,
+    CircuitBreaker,
+    GuardrailConfig,
+    QuarantineEntry,
+    should_hedge,
+)
 from .programs import ServeConfig, model_family
 from .router import AdmissionQueue, FleetRejected, Rejection, least_outstanding
 
@@ -92,6 +127,7 @@ class FleetConfig:
     cooldown_s: float = 1.0       # min seconds between scaling actions
     stall_s: float = 30.0         # heartbeat age that declares a replica dead
     autoscale: bool = True        # pressure/idle decisions (floor is always on)
+    guardrails: Optional[GuardrailConfig] = None  # None = reactive-only fleet
 
 
 class Autoscaler:
@@ -157,6 +193,14 @@ class ReplicaHandle:
         self.done: "deque[tuple]" = deque()   # (rid, tokens, final_logits)
         self.bad: "deque[tuple]" = deque()    # (rid, message) — engine reject
         self.assigned: set = set()            # rids routed here, not yet done
+        # Guardrail plumbing (all thread-safe deques; see guardrails.py):
+        self.faults: "deque[tuple]" = deque()     # (t, kind) replica-thread obs
+        self.cancels: "deque[tuple]" = deque()    # (rid, reason) ctrl → replica
+        self.cancelled: "deque[tuple]" = deque()  # (rid, toks, active) ← engine
+        self.breaker: Optional[CircuitBreaker] = None  # controller-owned
+        self.half_open = False                # quarantine probe: one request
+        self.tripped = False                  # breaker ejected it
+        self._slow_counted: Optional[float] = None  # last beat flagged slow
         self.stop_evt = threading.Event()
         self.drain_evt = threading.Event()
         self.work_evt = threading.Event()
@@ -190,6 +234,12 @@ class ReplicaHandle:
         if eng is not None:
             load += eng.outstanding_tokens()
         return load
+
+    def note_fault(self, kind: str) -> None:
+        """Record one breaker observation from the replica thread; the
+        controller drains it into the breaker window on its next tick
+        (the timestamp is the FAULT's, not the drain's)."""
+        self.faults.append((time.monotonic(), kind))
 
     def beat(self) -> None:
         self.last_beat = time.monotonic()
@@ -242,6 +292,14 @@ class ServeFleet:
         self._requests: Dict[str, Request] = {}
         self._stream_pos: Dict[str, int] = {}  # fleet-level dedupe
         self._stream_lock = threading.Lock()
+        # Guardrail state (docs/serving.md §Guardrails); gc None = off.
+        self.gc: Optional[GuardrailConfig] = self.fc.guardrails
+        self.quarantine: List[QuarantineEntry] = []
+        self.brownout = (Brownout(self.gc)
+                         if self.gc is not None and self.gc.brownout else None)
+        self.partial: Dict[str, List[int]] = {}  # delivered tokens, by rid
+        self._hedges: Dict[str, List[ReplicaHandle]] = {}  # rid → both targets
+        self._first_replica: Dict[str, int] = {}  # rid → idx that won TTFT
         self._wake = threading.Event()
         self._next_idx = 1
         self._tick_no = 0
@@ -270,6 +328,8 @@ class ServeFleet:
         replica seeing the caller's registry_dir."""
         h = ReplicaHandle(self._next_idx, tdx_config.get())
         self._next_idx += 1
+        if self.gc is not None and self.gc.breaker:
+            h.breaker = CircuitBreaker(self.gc)
         self.handles.append(h)
         h.set_state("launching")
         observe.counter("tdx.fleet.scale_ups").inc()
@@ -334,6 +394,8 @@ class ServeFleet:
             return "empty prompt"
         if req.max_new_tokens < 1:
             return f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            return f"deadline_s must be > 0, got {req.deadline_s}"
         need = self._kvcfg.pages_for(len(req.tokens) + 1)
         if need > self._kvcfg.usable_pages:
             return (f"prompt of {len(req.tokens)} tokens needs {need} pages "
@@ -366,14 +428,35 @@ class ServeFleet:
             rej = Rejection(req.rid, "invalid", detail)
             self._reject(rej)
             raise FleetRejected(rej)
+        if (self.brownout is not None and self.brownout.active
+                and req.priority < self.gc.brownout_priority):
+            # Brownout rejects low-priority work AT THE DOOR — queueing
+            # it just to shed it a tick later wastes queue depth the
+            # high-priority traffic needs.
+            rej = Rejection(
+                req.rid, "shed",
+                f"brownout: priority {req.priority} < "
+                f"{self.gc.brownout_priority} rejected at admission",
+            )
+            observe.counter("tdx.fleet.shed_requests").inc()
+            self._reject(rej)
+            raise FleetRejected(rej)
         try:
-            self.queue.push(req, deadline_s=deadline_s)
+            self.queue.push(
+                req,
+                deadline_s=(deadline_s if deadline_s is not None
+                            else req.deadline_s),
+            )
         except FleetRejected as e:
             self._reject(e.rejection)
             raise
         self._pending.add(req.rid)
         self._requests[req.rid] = req
         req._submit_t = time.perf_counter()
+        # End-to-end deadline, anchored at FLEET admission — queue wait
+        # counts against it, and it survives requeues onto new engines.
+        if req.deadline_s is not None and not hasattr(req, "_deadline_t"):
+            req._deadline_t = req._submit_t + req.deadline_s
 
     # -- the controller tick ------------------------------------------------
 
@@ -392,8 +475,10 @@ class ServeFleet:
 
     def tick(self) -> None:
         """One control step: expire deadlines → reap completions → reap
-        dead/drained replicas (requeue their work) → dispatch → scale.
-        Single-threaded: only the controller thread calls this."""
+        dead/drained replicas (requeue their work) → guardrails
+        (breakers → quarantine → hedge settlement → brownout) →
+        dispatch → scale.  Single-threaded: only the controller thread
+        calls this."""
         self._tick_no += 1
         now = time.monotonic()
         for rej in self.queue.expire(now=now):
@@ -406,12 +491,20 @@ class ServeFleet:
                 self._reap_dead(h)
             elif h.state == "drained":
                 self._reap_drained(h)
+        if self.gc is not None:
+            self._feed_breakers(now)
+            self._service_quarantine(now)
+            self._settle_hedges()
+            self._brownout_tick()
         self._dispatch()
         self._autoscale(now)
         if observe.enabled():
             observe.gauge("tdx.fleet.replicas").set(len(self.handles))
             observe.gauge("tdx.fleet.ready_replicas").set(
                 sum(1 for h in self.handles if h.state == "serving"))
+            if self.gc is not None:
+                observe.gauge("tdx.fleet.quarantined_replicas").set(
+                    len(self.quarantine))
 
     def _reap_completions(self, h: ReplicaHandle) -> None:
         while h.done:
@@ -421,17 +514,41 @@ class ServeFleet:
                 self._pending.discard(rid)   # replica may double-finish
                 self.results[rid] = toks
                 self.final_logits[rid] = logits
+                with self._stream_lock:
+                    self.partial.pop(rid, None)
+                    self._first_replica.pop(rid, None)
+                if h.half_open:
+                    # The probe request completed cleanly: the replica
+                    # earned its way back into full rotation.
+                    self._promote_half_open(h)
         while h.bad:
             rid, msg = h.bad.popleft()
             h.assigned.discard(rid)
             if rid in self._pending:
                 self._reject(Rejection(rid, "invalid", msg))
+        while h.cancelled:
+            # Engine-initiated deadline cancellations (mid-decode or
+            # while waiting inside the replica): typed rejection
+            # carrying tokens-so-far; pages were already freed.
+            rid, _toks, was_active = h.cancelled.popleft()
+            h.assigned.discard(rid)
+            if was_active:
+                observe.counter("tdx.fleet.cancelled_lanes").inc()
+            if rid in self._pending:
+                self._reject_deadline(rid, where="mid-decode"
+                                      if was_active else "replica-queue")
 
     def _requeue_assigned(self, h: ReplicaHandle, reqs: Sequence[Request],
                           *, why: str) -> None:
         for req in reqs:
             if req.rid not in self._pending:
                 continue  # completed before the replica went away
+            if any(x is not h and req.rid in x.assigned
+                   for x in self.handles):
+                # A hedge twin still holds a live copy — losing one
+                # racer must not spawn a THIRD dispatch.
+                h.assigned.discard(req.rid)
+                continue
             self.queue.requeue(req)
             h.assigned.discard(req.rid)
             observe.counter("tdx.fleet.requeued_requests").inc()
@@ -460,6 +577,8 @@ class ServeFleet:
         reqs = [self._requests[rid] for rid in sorted(h.assigned)
                 if rid in self._requests]
         self._requeue_assigned(h, reqs, why=why)
+        if h.half_open:
+            self._probe_failed(h, time.monotonic())
         self._remove(h)
 
     def _reap_drained(self, h: ReplicaHandle) -> None:
@@ -468,8 +587,173 @@ class ServeFleet:
         front, its KV pool is already freed — remove it."""
         self._reap_completions(h)  # lanes it finished while draining
         self._requeue_assigned(h, h.leftover, why="drain")
-        observe.counter("tdx.fleet.scale_downs").inc()
+        if not h.tripped:
+            # A breaker ejection is a guardrail action, not a scaling
+            # decision — it is counted in tdx.fleet.breaker_trips.
+            observe.counter("tdx.fleet.scale_downs").inc()
         self._remove(h)
+
+    # -- guardrails (docs/serving.md §Guardrails) ---------------------------
+
+    def _feed_breakers(self, now: float) -> None:
+        """Drain replica-thread fault observations into each breaker's
+        sliding window, add slow-tick observations controller-side, and
+        trip any breaker whose window filled — ejecting the replica
+        (drain if its heartbeat is live, kill if not) and opening a
+        quarantine entry with exponential backoff."""
+        gc = self.gc
+        if not gc.breaker:
+            return
+        for h in list(self.handles):
+            if h.breaker is None or h.state not in ("serving", "draining"):
+                continue
+            while h.faults:
+                t, kind = h.faults.popleft()
+                h.breaker.record(t, kind)
+            beat_age = now - h.last_beat
+            if (gc.slow_tick_s is not None and h.state == "serving"
+                    and beat_age > gc.slow_tick_s
+                    and h._slow_counted != h.last_beat
+                    and (h.inbox or h.assigned)):
+                # One observation per slow EPISODE: the beat timestamp
+                # is the episode's identity (a wedged thread stops
+                # beating; counting every tick would trip on one stall).
+                h.breaker.record(now, "slow")
+                h._slow_counted = h.last_beat
+            if h.state == "serving" and h.breaker.tripped(now):
+                self._trip_breaker(h, now)
+
+    def _trip_breaker(self, h: ReplicaHandle, now: float) -> None:
+        gc = self.gc
+        h.tripped = True
+        observe.counter("tdx.fleet.breaker_trips").inc()
+        observe.instant("fleet.breaker_trip", category="serve",
+                        replica=h.idx, window=h.breaker.count(now))
+        self._log.warning(
+            "fleet: breaker tripped on r%d (%d faults in %.1fs window)",
+            h.idx, h.breaker.count(now), gc.breaker_window_s,
+        )
+        if h.half_open:
+            # The probe itself misbehaved: double the origin entry's
+            # backoff instead of opening a second quarantine record.
+            for q in self.quarantine:
+                if q.probe_idx == h.idx:
+                    q.fail_probe(now, gc)
+                    break
+        else:
+            self.quarantine.append(QuarantineEntry(
+                origin_idx=h.idx, until=now + gc.quarantine_s,
+                backoff_s=gc.quarantine_s,
+            ))
+        responsive = (now - h.last_beat) <= max(
+            1.0, gc.slow_tick_s or 0.0)
+        if responsive:
+            # Eject politely: finish in-flight lanes, hand back the
+            # backlog (reaped via the normal drained path).
+            h.set_state("draining")
+            h.drain_evt.set()
+            h.work_evt.set()
+        else:
+            # Not responding — kill: requeue its work and remove it;
+            # the stop event lets the thread exit when it wakes.
+            reqs = [self._requests[rid] for rid in sorted(h.assigned)
+                    if rid in self._requests]
+            self._requeue_assigned(h, reqs, why="breaker")
+            self._remove(h)
+
+    def _service_quarantine(self, now: float) -> None:
+        """Expired quarantine entries re-admit capacity HALF-OPEN: a
+        fresh replica (registry-warm respawn) that gets exactly one
+        probe request; a clean completion promotes it to full rotation
+        (:meth:`_reap_completions`), a failure doubles the backoff."""
+        for q in self.quarantine:
+            if q.probe_idx is not None or now < q.until:
+                continue
+            if len(self.handles) >= self.fc.max_replicas:
+                continue  # no headroom this tick; retry next tick
+            h = self.scale_up()
+            h.half_open = True
+            q.probe_idx = h.idx
+            observe.counter("tdx.fleet.half_open_probes").inc()
+            observe.instant("fleet.half_open_probe", category="serve",
+                            replica=h.idx, origin=q.origin_idx)
+
+    def _probe_failed(self, h: ReplicaHandle, now: float) -> None:
+        """A half-open replica died before completing its probe."""
+        for q in self.quarantine:
+            if q.probe_idx == h.idx:
+                q.fail_probe(now, self.gc)
+                observe.instant("fleet.probe_failed", category="serve",
+                                replica=h.idx, origin=q.origin_idx,
+                                backoff_s=round(q.backoff_s, 3))
+                return
+
+    def _promote_half_open(self, h: ReplicaHandle) -> None:
+        """The probe completed cleanly: full rotation, quarantine over."""
+        h.half_open = False
+        self.quarantine = [q for q in self.quarantine
+                           if q.probe_idx != h.idx]
+        observe.instant("fleet.probe_ok", category="serve", replica=h.idx)
+
+    def _settle_hedges(self) -> None:
+        """Resolve hedge races: once a hedged request's first token
+        arrived (or it completed), cancel the copy on every OTHER
+        replica — the loser's lane frees its pages now instead of
+        burning a duplicate decode to completion.  Greedy decode plus
+        the fleet-level stream dedupe make the race invisible to the
+        client whichever replica wins."""
+        if not self._hedges:
+            return
+        for rid in list(self._hedges):
+            if rid not in self._pending:
+                # Completed or rejected; cancel any straggler copies.
+                for h in self._hedges.pop(rid):
+                    if h in self.handles and rid in h.assigned:
+                        h.assigned.discard(rid)
+                        h.cancels.append((rid, "hedge_settled"))
+                        h.work_evt.set()
+                continue
+            with self._stream_lock:
+                winner = self._first_replica.get(rid)
+            if winner is None:
+                continue  # race still running
+            observe.counter("tdx.fleet.hedge_wins").inc()
+            observe.instant("fleet.hedge_win", category="serve",
+                            rid=rid, replica=winner)
+            for h in self._hedges.pop(rid):
+                if h.idx != winner and h in self.handles \
+                        and rid in h.assigned:
+                    h.assigned.discard(rid)
+                    h.cancels.append((rid, "hedge_lost"))
+                    h.work_evt.set()
+
+    def _brownout_tick(self) -> None:
+        if self.brownout is None:
+            return
+        was = self.brownout.active
+        serving = sum(1 for h in self.handles if h.state == "serving")
+        active = self.brownout.observe(
+            queued=self.queue.depth(), serving=serving,
+            ttft_p95=self._ttft_p95(),
+        )
+        if active and not was:
+            observe.counter("tdx.fleet.brownouts").inc()
+            observe.instant("fleet.brownout_enter", category="serve",
+                            queued=self.queue.depth(), serving=serving)
+            self._log.warning(
+                "fleet: entering brownout (queued=%d, serving=%d)",
+                self.queue.depth(), serving,
+            )
+        elif was and not active:
+            observe.instant("fleet.brownout_exit", category="serve")
+        if active:
+            # Shed QUEUED low-priority entries every brownout tick —
+            # work queued just before entry, plus any that trickled in.
+            for rej in self.queue.shed_low_priority(self.gc.brownout_priority):
+                observe.counter("tdx.fleet.shed_requests").inc()
+                self._reject(rej)
+
+    # -- dispatch -----------------------------------------------------------
 
     def _dispatch(self) -> None:
         serving = [h for h in self.handles if h.state == "serving"]
@@ -477,15 +761,65 @@ class ServeFleet:
             return
         cap = max(1, int(self._resolved.max_batch
                          * self.fc.dispatch_per_replica))
+        now = time.monotonic()
         while True:
-            ready = [h for h in serving if len(h.assigned) < cap]
+            # A half-open replica is on probation: exactly ONE request
+            # until its probe completes (docs/serving.md §Guardrails).
+            ready = [h for h in serving
+                     if len(h.assigned) < (1 if h.half_open else cap)]
             if not ready:
                 return  # backlog stays queued → visible scale pressure
-            entry = self.queue.pop()
+            entry = self.queue.pop(now=now)
             if entry is None:
                 return
+            req = entry.req
+            dl = getattr(req, "_deadline_t", None)
+            if dl is not None and time.perf_counter() > dl:
+                # Dispatch-time deadline check: requeued entries are
+                # exempt from the QUEUE deadline (an admitted request
+                # is a promise), but a promise the client stopped
+                # waiting for is not worth a replica's time — typed
+                # rejection carrying whatever was already delivered.
+                self._reject_deadline(req.rid, where="dispatch")
+                continue
             h = least_outstanding(ready, lambda x: x.outstanding())
-            h.give(entry.req)
+            h.give(req)
+            if self.gc is not None and len(ready) > 1:
+                waited = now - entry.enqueued_t
+                if (req.rid not in self._hedges
+                        and should_hedge(waited, req.deadline_s, self.gc)):
+                    mates = [x for x in ready
+                             if x is not h and not x.half_open]
+                    mate = least_outstanding(mates,
+                                             lambda x: x.outstanding())
+                    if mate is not None:
+                        mate.give(req)
+                        self._hedges[req.rid] = [h, mate]
+                        observe.counter("tdx.fleet.hedged_requests").inc()
+                        observe.instant(
+                            "fleet.hedge", category="serve", rid=req.rid,
+                            primary=h.idx, mate=mate.idx,
+                            waited_s=round(waited, 4),
+                        )
+
+    def _reject_deadline(self, rid: str, *, where: str) -> None:
+        """Typed ``deadline`` rejection carrying tokens-so-far; also
+        cancels any other live copies of the request (hedge twins)."""
+        with self._stream_lock:
+            partial = tuple(self.partial.pop(rid, ()))
+            self._first_replica.pop(rid, None)
+        self._reject(Rejection(
+            rid, "deadline",
+            f"end-to-end deadline exceeded ({where}); "
+            f"{len(partial)} tokens delivered",
+            tokens=partial,
+        ))
+        for h in self.handles:
+            if rid in h.assigned:
+                h.assigned.discard(rid)
+                h.cancels.append((rid, "deadline"))
+                h.work_evt.set()
+        self._hedges.pop(rid, None)
 
     def _autoscale(self, now: float) -> None:
         serving = sum(1 for h in self.handles if h.state == "serving")
@@ -574,6 +908,14 @@ class ServeFleet:
                 if pos <= self._stream_pos.get(rid, 0):
                     return  # already streamed by a previous replica
                 self._stream_pos[rid] = pos
+                # Delivered-token log: a mid-decode deadline rejection
+                # carries these back to the requester (tokens-so-far).
+                self.partial.setdefault(rid, []).append(token)
+                # Hedge-race arbitration: the replica that delivered the
+                # request's FIRST token wins; the controller cancels the
+                # other copy on its next tick (_settle_hedges).
+                if pos == 1:
+                    self._first_replica[rid] = h.idx
             if user is not None:
                 user(rid, token)
 
@@ -591,6 +933,21 @@ class ServeFleet:
         if plan is None:
             return
         for fault in plan.take("fleet", h.idx):
+            if fault.kind == "flap":
+                # Intermittent fault: the replica SURVIVES it — the
+                # batch requeues (recompute preemption, same bitwise
+                # contract) and the fault lands in the breaker window.
+                # A flaky replica therefore keeps serving, keeps
+                # faulting, and keeps burning latency until the breaker
+                # trips and the controller ejects it — exactly the
+                # failure mode proactive guardrails exist for.
+                try:
+                    chaos.execute_replica_fault(fault)
+                except Exception:
+                    h.note_fault("flap")
+                    if h.engine is not None:
+                        h.engine.requeue_active(reason="fault")
+                continue
             chaos.execute_replica_fault(fault)
 
     def _replica_main(self, h: ReplicaHandle) -> None:
@@ -606,6 +963,10 @@ class ServeFleet:
                     on_token=self._make_on_token(h),
                     on_complete=lambda rid, toks, logits: (
                         h.done.append((rid, toks, logits)),
+                        self._wake.set(),
+                    ),
+                    on_cancel=lambda rid, toks, active: (
+                        h.cancelled.append((rid, toks, active)),
                         self._wake.set(),
                     ),
                     health_component=h.component, slo_name=h.slo_name,
@@ -640,6 +1001,22 @@ class ServeFleet:
                 h.leftover = leftover
                 h.set_state("drained")
                 return
+            while h.cancels:
+                # Controller-issued cancellations (hedge losers, doomed
+                # dispatches): drop the copy wherever it is — inbox,
+                # engine queue, or an ACTIVE LANE, whose pages go back
+                # to the pool right now.  No on_cancel echo: the
+                # controller initiated this and already bookkept it.
+                rid, reason = h.cancels.popleft()
+                for r in list(h.inbox):
+                    if r.rid == rid:
+                        try:
+                            h.inbox.remove(r)
+                        except ValueError:
+                            pass  # popped by the submit loop meanwhile
+                toks = engine.cancel(rid, reason=reason)
+                if toks:  # non-empty ⇒ an active lane was cancelled
+                    observe.counter("tdx.fleet.cancelled_lanes").inc()
             while h.inbox:
                 req = h.inbox.popleft()
                 req.arrival_step = 0  # fleet ticks ≠ this engine's steps

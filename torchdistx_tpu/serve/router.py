@@ -42,19 +42,23 @@ __all__ = [
     "least_outstanding",
 ]
 
-REJECT_REASONS = ("queue_full", "deadline", "invalid")
+REJECT_REASONS = ("queue_full", "deadline", "invalid", "shed")
 
 
 @dataclass(frozen=True)
 class Rejection:
-    """One typed admission rejection: the client gets a reason it can
-    act on (back off / retry elsewhere / fix the request), the fleet
-    counts it (``tdx.fleet.rejected_requests``), and nothing is silently
-    dropped."""
+    """One typed rejection: the client gets a reason it can act on
+    (back off / retry elsewhere / fix the request), the fleet counts it
+    (``tdx.fleet.rejected_requests``), and nothing is silently dropped.
+    A ``deadline`` rejection issued after admission (a lane cancelled
+    mid-decode, docs/serving.md §Guardrails) carries the tokens the
+    client already received in ``tokens``; ``shed`` is the brownout
+    reason (low-priority work dropped under sustained pressure)."""
 
     rid: str
     reason: str  # one of REJECT_REASONS
     detail: str = ""
+    tokens: Tuple[int, ...] = ()  # delivered-so-far (mid-decode deadline)
 
 
 class FleetRejected(ValueError):
@@ -146,6 +150,29 @@ class AdmissionQueue:
                         entry.req.rid, "deadline",
                         f"queued {waited:.3f}s > deadline "
                         f"{entry.deadline_s:.3f}s",
+                    ))
+                else:
+                    keep.append(entry)
+            self._fifo = keep
+        return out
+
+    def shed_low_priority(self, min_priority: int) -> List[Rejection]:
+        """Brownout shedding: remove every QUEUED entry whose request
+        priority is below ``min_priority``; returns their typed
+        rejections (reason ``shed``).  The front (requeue) lane is
+        exempt — a requeued request is admitted in-flight work, a
+        promise the brownout must not break (same contract that exempts
+        it from the bound and the deadline)."""
+        out: List[Rejection] = []
+        with self._lock:
+            keep: "deque[QueueEntry]" = deque()
+            for entry in self._fifo:
+                prio = getattr(entry.req, "priority", 1)
+                if prio < min_priority:
+                    out.append(Rejection(
+                        entry.req.rid, "shed",
+                        f"brownout: queued priority {prio} < "
+                        f"{min_priority} shed under pressure",
                     ))
                 else:
                     keep.append(entry)
