@@ -1928,6 +1928,238 @@ def phase_guardrails() -> dict:
     return out
 
 
+def phase_serving_prefix() -> dict:
+    """Prefix-sharing + chunked-prefill phase (docs/serving.md §Prefix
+    sharing & chunked prefill): the SAME 48-request storm — 80% of
+    requests sharing a two-page preamble — is driven twice through one
+    replica shape, once with the prefix cache OFF (every prompt pays its
+    full prefill) and once ON (followers map the preamble's KV pages
+    copy-on-write and prefill only their suffix).
+    ``prefix_tokens_per_s_improvement`` and
+    ``prefix_p95_ttft_improvement`` are the on/off ratios — the sharing
+    claim is precisely that reused prefix tokens cost ZERO prefill
+    FLOPs, and both throughput and tail TTFT show it.
+
+    A second A/B drives a long-prompt storm (prompts LONGER than the
+    largest prefill bucket — served chunked, where the seed engine
+    rejected them) at a coarse chunk (the whole largest bucket per tick,
+    the closest thing to the old single-shot) vs a fine chunk, and
+    measures a concurrent short request's TTFT:
+    ``prefix_chunked_short_ttft_improvement`` is coarse / fine — bounded
+    per-tick prefill work is what lets the short request's first token
+    through.
+
+    Gates (raise ⇒ CI fails, not just a slow number): every output in
+    every arm equals the unbatched no-cache oracle, the ON arm reuses
+    pages (prefix hits > 0), both headline ratios exceed 1, the
+    oversized prompts complete (not reject), and every arm drains to
+    ZERO live pages."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("TDX_CACHE_MIN_COMPILE_S", "0")
+    jax = _virtual_cpu_init(1)
+    import numpy as np
+
+    import jax.numpy as jnp
+    import torchdistx_tpu.config as tdx_config
+    from torchdistx_tpu import observe
+    from torchdistx_tpu.jax_bridge import materialize as mat
+    from torchdistx_tpu.models import TransformerConfig
+    from torchdistx_tpu.serve import (
+        Request, ServeConfig, oracle_generate, spin_up_replica,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=256, max_seq_len=160, dtype=jnp.float32,
+    )
+
+    def scfg(**kw):
+        return ServeConfig(max_batch=4, page_size=8, n_pages=64,
+                           max_pages_per_seq=10,
+                           prefill_buckets=(8, 64), **kw)
+
+    # 48 requests, 80% sharing a 48-token (six-page) preamble.  Suffixes
+    # land in the 8-bucket; the full prompts land in the 64-bucket — the
+    # FLOP gap sharing refunds.  Short generations keep decode (whose
+    # cost is identical in both arms) from drowning the prefill signal.
+    preamble = [(31 * i + 7) % cfg.vocab_size for i in range(48)]
+    rng = np.random.RandomState(29)
+    prompts = []
+    for i in range(48):
+        if i % 5 == 4:  # the 20% unshared floor
+            prompts.append([int(t) for t in
+                            rng.randint(0, cfg.vocab_size,
+                                        size=3 + int(rng.randint(8)))])
+        else:
+            prompts.append(preamble + [int(t) for t in
+                                       rng.randint(0, cfg.vocab_size,
+                                                   size=2 + int(rng.randint(7)))])
+
+    # One generated token per request: decode cost (identical in both
+    # arms — the page-table gather is the tick's fixed price) would
+    # otherwise drown the prefill delta that sharing refunds.
+    def storm(tag):
+        return [Request(f"{tag}{i}", prompts[i],
+                        max_new_tokens=1, arrival_step=i // 4)
+                for i in range(48)]
+
+    oracle_cache = {}
+
+    def check_oracle(eng, reqs, results):
+        for r in reqs:
+            key = (tuple(r.tokens), r.max_new_tokens)
+            if key not in oracle_cache:
+                oracle_cache[key] = oracle_generate(
+                    "llama", cfg, eng.params, r.tokens, r.max_new_tokens)[0]
+            if results.get(r.rid) != oracle_cache[key]:
+                raise RuntimeError(
+                    f"serving output diverged from the unbatched oracle "
+                    f"on {r.rid}"
+                )
+
+    def csnap():
+        return {r["name"]: r["value"] for r in observe.counters().snapshot()
+                if r["type"] == "counter"}
+
+    def run_storm(eng, reqs):
+        """(tokens/s, p95 TTFT) for one storm through ``eng``."""
+        ttft = {}
+        prev = eng.on_token
+        eng.on_token = lambda rid, tok: ttft.setdefault(
+            rid, time.perf_counter())
+        try:
+            t0 = time.perf_counter()
+            results = eng.run(reqs)
+            dt = time.perf_counter() - t0
+        finally:
+            eng.on_token = prev
+        check_oracle(eng, reqs, results)
+        n_tok = sum(len(results[r.rid]) for r in reqs)
+        p95 = float(np.percentile([ttft[r.rid] - t0 for r in reqs], 95))
+        eng.drain()
+        if eng.kv.pages_in_use != 0:
+            raise RuntimeError(
+                f"{eng.kv.pages_in_use} pages still live after drain"
+            )
+        return n_tok / dt, p95
+
+    jax.devices()
+    out = {"model_d": cfg.d_model, "n_layers": cfg.n_layers,
+           "storm_requests": 48, "shared_fraction": 0.8,
+           "host_cpu_count": os.cpu_count()}
+    cache = tempfile.mkdtemp(prefix="tdx_prefix_bench_")
+    try:
+        mat._reset_cache_binding()
+        observe.enable(True)
+        with tdx_config.override(cache_dir=cache):
+            # OFF: every prompt pays its full (bucketed) prefill.  The
+            # bring-up compiles the shared program set into the local
+            # cache; every later engine is a pure cache hit, so the
+            # timed storms never see the compiler.
+            eng = spin_up_replica(cfg, family="llama",
+                                  serve_cfg=scfg(prefix_cache=False))
+            tps_off, p95_off = run_storm(eng, storm("off"))
+
+            # ON: followers map the cached preamble pages and prefill
+            # only their suffix.
+            base = csnap()
+            eng = spin_up_replica(cfg, family="llama", serve_cfg=scfg())
+            tps_on, p95_on = run_storm(eng, storm("on"))
+            snap = csnap()
+            for short, name in (("hits", "prefix_hits"),
+                                ("tokens_reused", "prefix_tokens_reused"),
+                                ("cow", "cow_copies")):
+                out[f"prefix_{short}"] = int(
+                    snap.get(f"tdx.serve.{name}", 0)
+                    - base.get(f"tdx.serve.{name}", 0))
+            if out["prefix_hits"] < 24 or out["prefix_tokens_reused"] < 24 * 48:
+                raise RuntimeError(
+                    f"the 80%-shared storm should hit the prefix cache "
+                    f"~38 times at 48 tokens each, saw "
+                    f"{out['prefix_hits']} / {out['prefix_tokens_reused']}"
+                )
+
+            # Chunked prefill: prompts LONGER than the largest bucket
+            # (the seed engine rejected these), coarse chunk vs fine,
+            # with one short request stuck behind the long storm.
+            def chunk_storm(tag, chunk):
+                eng = spin_up_replica(
+                    cfg, family="llama",
+                    serve_cfg=scfg(prefill_chunk=chunk, prefix_cache=False))
+                longs = [Request(
+                    f"{tag}L{i}",
+                    [int(t) for t in rng.randint(0, cfg.vocab_size, size=68)],
+                    max_new_tokens=2) for i in range(3)]
+                short = Request(f"{tag}S", [9, 2, 9], max_new_tokens=4,
+                                arrival_step=1)
+                ttft = {}
+                eng.on_token = lambda rid, tok: ttft.setdefault(
+                    rid, time.perf_counter())
+                t0 = time.perf_counter()
+                results = eng.run(longs + [short])
+                check_oracle(eng, longs + [short], results)
+                eng.drain()
+                if eng.kv.pages_in_use != 0:
+                    raise RuntimeError(
+                        f"{tag}: pages leaked after the chunked storm"
+                    )
+                return ttft[short.rid] - t0
+
+            # Best-of-5 per arm: a single short-request TTFT is a ~10 ms
+            # sample on a shared host; the structural gap (how much
+            # prefill work each tick runs before the short request's
+            # turn) is deterministic, so min() strips scheduler noise.
+            base = csnap()
+            short_coarse = min(chunk_storm(f"coarse{n}", 64)
+                               for n in range(5))
+            short_fine = min(chunk_storm(f"fine{n}", 8) for n in range(5))
+            chunks = int(csnap().get("tdx.serve.prefill_chunks", 0)
+                         - base.get("tdx.serve.prefill_chunks", 0))
+            # The fine arms alone need ceil(68/8)=9 chunks per long
+            # prompt per repetition.
+            if chunks < 5 * 27:
+                raise RuntimeError(
+                    f"oversized prompts did not prefill chunked "
+                    f"({chunks} chunks)"
+                )
+            out["prefill_chunks"] = chunks
+    finally:
+        observe.enable(None)
+        mat._reset_cache_binding()
+        shutil.rmtree(cache, ignore_errors=True)
+
+    out["prefix_off_tokens_per_s"] = round(tps_off, 2)
+    out["prefix_on_tokens_per_s"] = round(tps_on, 2)
+    out["prefix_tokens_per_s_improvement"] = round(tps_on / tps_off, 3)
+    out["prefix_off_p95_ttft_s"] = round(p95_off, 4)
+    out["prefix_on_p95_ttft_s"] = round(p95_on, 4)
+    out["prefix_p95_ttft_improvement"] = round(p95_off / p95_on, 3)
+    out["chunked_short_ttft_coarse_s"] = round(short_coarse, 4)
+    out["chunked_short_ttft_fine_s"] = round(short_fine, 4)
+    out["prefix_chunked_short_ttft_improvement"] = round(
+        short_coarse / short_fine, 3)
+    if out["prefix_tokens_per_s_improvement"] <= 1:
+        raise RuntimeError(
+            f"prefix sharing did not improve throughput: "
+            f"{tps_off:.1f} -> {tps_on:.1f} tok/s"
+        )
+    if out["prefix_p95_ttft_improvement"] <= 1:
+        raise RuntimeError(
+            f"prefix sharing did not improve p95 TTFT: "
+            f"{p95_off:.4f}s -> {p95_on:.4f}s"
+        )
+    if out["prefix_chunked_short_ttft_improvement"] <= 1:
+        raise RuntimeError(
+            f"fine chunking did not improve the short request's TTFT: "
+            f"coarse {short_coarse:.4f}s vs fine {short_fine:.4f}s"
+        )
+    out["oracle_equal"] = True
+    out["backend"] = "cpu"
+    return out
+
+
 def phase_pp_bubble() -> dict:
     """STATIC schedule analysis (no hardware, no wall clocks — tick
     counts and buffer sizes are properties of the schedule tables, so
@@ -2276,6 +2508,7 @@ PHASES = {
     "schedule_measured": phase_schedule_measured,
     "serving": phase_serving,
     "serving_fleet": phase_serving_fleet,
+    "serving_prefix": phase_serving_prefix,
     "guardrails": phase_guardrails,
     "train_mfu": phase_train_mfu,
     "materialize_pipeline": phase_materialize_pipeline,
@@ -2890,6 +3123,19 @@ def main() -> None:
     else:
         out["serving_fleet_error"] = sf["error"][-160:]
 
+    sp = _run_phase("serving_prefix", timeout=900.0)
+    sp.pop("_backend", None)  # forced-CPU sharing A/B: cpu by design
+    if "error" not in sp:
+        out["serving_prefix"] = sp
+        # Promoted headline keys: the SAME 80%-shared storm, prefix
+        # cache off / on.
+        for key in ("prefix_tokens_per_s_improvement",
+                    "prefix_p95_ttft_improvement"):
+            if sp.get(key) is not None:
+                out[key] = sp[key]
+    else:
+        out["serving_prefix_error"] = sp["error"][-160:]
+
     gr = _run_phase("guardrails", timeout=900.0)
     gr.pop("_backend", None)  # forced-CPU guardrail A/B: cpu by design
     if "error" not in gr:
@@ -2943,6 +3189,7 @@ _HEADLINE_KEYS = (
     "reshard_gbps", "reshard_bytes_moved",
     "fleet_scaleup_warm_speedup", "fleet_scaling_efficiency_2r",
     "guardrails_p95_ttft_improvement",
+    "prefix_tokens_per_s_improvement", "prefix_p95_ttft_improvement",
     "train_mfu", "train_mfu_xla", "train_tokens_per_s", "train_step_ms",
     "train_stale_s", "train_mfu_skipped", "train_mfu_error",
     "flash_mfu", "flash_speedup", "flash_bwd_mfu", "flash_bwd_speedup",

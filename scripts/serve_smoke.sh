@@ -29,7 +29,8 @@ import json, sys
 rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
 assert not rep["unwarmed"], rep["unwarmed"]
 names = {r["program"] for r in rep["program_reports"]}
-assert names == {"init", "prefill-8", "prefill-16", "decode"}, names
+assert names == {"init", "prefill-8", "prefill-16", "chunk-8", "chunk-16",
+                 "cow", "decode"}, names
 print(f"  OK: {len(names)} programs published")
 EOF
 
@@ -76,9 +77,34 @@ for r in reqs:
 snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
         if r["type"] == "counter"}
 assert snap.get("tdx.serve.requests_completed", 0) >= len(reqs)
-assert eng.kv.pages_in_use == 0  # every retirement freed its pages
+# Every retirement freed its table; only prefix-cache blocks stay live.
+assert eng.kv.pages_in_use == eng.prefix.page_count(), (
+    eng.kv.pages_in_use, eng.prefix.page_count())
 print(f"  OK: {len(reqs)} requests complete, all == unbatched oracle, "
       f"{int(snap['tdx.serve.decode_steps'])} decode steps")
+
+# Shared-prefix storm: requests sharing a page-aligned preamble must
+# reuse its KV pages (prefix hits counted), stay bitwise-equal to the
+# oracle, and leave zero pages live after drain.
+preamble = [int(t) for t in rng.randint(0, 256, size=8)]
+storm = [Request(f"s{i}", preamble + [int(t) for t in rng.randint(0, 256, size=2)],
+                 max_new_tokens=3, arrival_step=2 * i)
+         for i in range(6)]
+out = eng.run(storm)
+for r in storm:
+    want, _ = oracle_generate(eng.family, eng.cfg, eng.params, r.tokens,
+                              r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, out[r.rid], want)
+snap = {r["name"]: r["value"] for r in observe.counters().snapshot()
+        if r["type"] == "counter"}
+hits = snap.get("tdx.serve.prefix_hits", 0)
+reused = snap.get("tdx.serve.prefix_tokens_reused", 0)
+assert hits > 0, "shared-prefix storm must hit the prefix cache"
+assert reused >= 8 * hits, (hits, reused)
+eng.drain()
+assert eng.kv.pages_in_use == 0  # drain releases tables AND the tree
+print(f"  OK: prefix storm == oracle, {int(hits)} prefix hits, "
+      f"{int(reused)} KV tokens reused, 0 pages live after drain")
 EOF
 
 echo "serve-smoke OK"

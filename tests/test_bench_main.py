@@ -93,6 +93,18 @@ _HOST_PHASES = {
         "fleet_scaling_efficiency_2r": 1.176, "chaos_requeued": 4,
         "warm_local_compiles": 0, "oracle_equal": True,
         "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
+    "serving_prefix": {
+        "storm_requests": 48, "prefix_hits": 38,
+        "prefix_tokens_reused": 1824, "prefix_cow": 2,
+        "prefill_chunks": 150,
+        "prefix_off_tokens_per_s": 357.2, "prefix_on_tokens_per_s": 656.9,
+        "prefix_tokens_per_s_improvement": 1.839,
+        "prefix_off_p95_ttft_s": 0.0132, "prefix_on_p95_ttft_s": 0.0071,
+        "prefix_p95_ttft_improvement": 1.848,
+        "chunked_short_ttft_coarse_s": 0.0119,
+        "chunked_short_ttft_fine_s": 0.0091,
+        "prefix_chunked_short_ttft_improvement": 1.31, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "guardrails": {
         "storm_requests": 48, "bring_up_cold_s": 4.2,
         "guardrails_breaker_trips": 1, "guardrails_hedged": 0,
@@ -171,6 +183,9 @@ def test_healthy_branch_headline_and_detail(bench):
     assert full["serving_fleet"]["chaos_requeued"] == 4
     assert headline["guardrails_p95_ttft_improvement"] == 1.848
     assert full["guardrails"]["guardrails_breaker_trips"] == 1
+    assert headline["prefix_tokens_per_s_improvement"] == 1.839
+    assert headline["prefix_p95_ttft_improvement"] == 1.848
+    assert full["serving_prefix"]["prefix_hits"] == 38
     assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208

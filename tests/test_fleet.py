@@ -349,9 +349,11 @@ def test_rejection_paths_are_typed_and_counted(shared_cache):
         with pytest.raises(FleetRejected) as ei:
             fl.submit(Request("bad", [], max_new_tokens=4))
         assert ei.value.rejection.reason == "invalid"
+        # 20 tokens > the largest bucket now serves (chunked prefill);
+        # only max_context (3 pages * 8) rejects at the door.
         with pytest.raises(FleetRejected) as ei:
-            fl.submit(Request("huge", [1] * 20, max_new_tokens=2))
-        assert "prefill bucket" in ei.value.rejection.detail
+            fl.submit(Request("huge", [1] * 23, max_new_tokens=2))
+        assert "max_context" in ei.value.rejection.detail
         fl.submit(Request("q1", [1, 2], max_new_tokens=2))
         fl.submit(Request("q2", [1, 2], max_new_tokens=2))
         with pytest.raises(FleetRejected) as ei:

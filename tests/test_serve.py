@@ -223,14 +223,21 @@ def test_submit_validation(llama_engine):
         llama_engine.submit(Request("bad", [], max_new_tokens=1))
     with pytest.raises(ValueError, match="max_context"):
         llama_engine.submit(Request("big", [1] * 20, max_new_tokens=20))
-    # Fits the context cap but exceeds the largest explicit prefill
-    # bucket: rejected at the door, not mid-loop.
-    with pytest.raises(ValueError, match="prefill bucket"):
-        llama_engine.submit(Request("wide", [1] * 18, max_new_tokens=2))
     # A zero budget would emit prefill's token while the oracle
     # generates nothing: rejected.
     with pytest.raises(ValueError, match="max_new_tokens"):
         llama_engine.submit(Request("zero", [1, 2], max_new_tokens=0))
+
+
+def test_prompt_beyond_largest_bucket_serves_chunked(llama_engine):
+    """A prompt larger than the largest prefill bucket used to be
+    rejected at submit; chunked prefill serves it (and it still matches
+    the oracle bitwise)."""
+    assert 18 > llama_engine.scfg.prefill_buckets[-1]
+    r = Request("wide", [(7 * i) % 128 for i in range(18)],
+                max_new_tokens=2)
+    out = llama_engine.run([r])
+    _check_oracle(llama_engine, [r], out)
 
 
 def test_serve_telemetry_vocabulary(llama_params, llama_engine):
@@ -276,7 +283,8 @@ def test_registry_warmed_bring_up_zero_local_compiles():
         assert not summary["unwarmed"], summary
         assert summary["programs"] == len(summary["program_reports"])
         names = {r["program"] for r in summary["program_reports"]}
-        assert names == {"init", "prefill-8", "prefill-16", "decode"}
+        assert names == {"init", "prefill-8", "prefill-16",
+                         "chunk-8", "chunk-16", "cow", "decode"}
 
         mat._reset_cache_binding()
         base = {r["name"]: r["value"]
@@ -326,12 +334,14 @@ def test_program_fingerprints_are_shape_sensitive():
     d = {s.name: s.program_fp
          for s in serve_program_specs("llama", LLAMA, SCFG, seed=1)}
     assert d["init"] != a["init"]
-    # max_new_tokens is a host-side budget no compiled program reads:
-    # changing it must NOT invalidate a warmed registry.
+    # max_new_tokens / prefill_chunk / prefix_cache are host-side knobs
+    # no compiled program reads: changing them must NOT invalidate a
+    # warmed registry.
     e = {s.name: s.program_fp
          for s in serve_program_specs(
              "llama", LLAMA,
              ServeConfig(max_batch=2, page_size=8, n_pages=16,
                          max_pages_per_seq=3, prefill_buckets=(8, 16),
-                         max_new_tokens=99))}
+                         max_new_tokens=99, prefill_chunk=5,
+                         prefix_cache=False))}
     assert e == a

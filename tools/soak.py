@@ -544,6 +544,10 @@ def _serve_oracle(seed: int, plan_text: "str | None"):
         n_pages=rng.randrange(8, 14),  # deliberately tight
         max_pages_per_seq=4,
         prefill_buckets=(8,),
+        # Exercise the chunked-prefill scheduler at every size, the
+        # prefix-sharing hot path, and the sharing-off control arm.
+        prefill_chunk=rng.choice([None, 2, 3, 5, 8]),
+        prefix_cache=rng.random() < 0.75,
     )
     resolved = scfg.resolve(cfg)
     family = "llama"
@@ -552,11 +556,20 @@ def _serve_oracle(seed: int, plan_text: "str | None"):
     compiled, _ = compile_serving_program(init)
     params = jax.tree.unflatten(init.treedef, list(compiled()))
 
+    # A randomized fraction of requests shares a page-aligned preamble
+    # so COW, tree eviction, and refcounted free all fire under chaos.
+    shared_frac = rng.choice([0.0, 0.5, 0.8])
+    preamble = [rng.randrange(cfg.vocab_size)
+                for _ in range(resolved.page_size)]
     n_req = rng.randrange(3, 6)
     reqs = []
     for i in range(n_req):
-        prompt = [rng.randrange(cfg.vocab_size) for _ in
-                  range(rng.randrange(1, 8))]
+        if rng.random() < shared_frac:
+            prompt = preamble + [rng.randrange(cfg.vocab_size) for _ in
+                                 range(rng.randrange(0, 4))]
+        else:
+            prompt = [rng.randrange(cfg.vocab_size) for _ in
+                      range(rng.randrange(1, 8))]
         budget = rng.randrange(1, 1 + min(
             8, resolved.max_context - len(prompt)))
         reqs.append(Request(
@@ -569,8 +582,12 @@ def _serve_oracle(seed: int, plan_text: "str | None"):
     else:
         entries = []
         for _ in range(rng.randrange(1, 3)):
-            kind = rng.choice(["raise", "slow"])
-            arg = ":0.05" if kind == "slow" else ""
+            kind = rng.choice(["raise", "raise", "slow"])
+            if kind == "slow":
+                arg = ":0.05"
+            else:
+                # Half the raises land BETWEEN prefill chunks.
+                arg = ":chunk" if rng.random() < 0.5 else ""
             entries.append(f"serve@{rng.randrange(1, 6)}={kind}{arg}")
         plan = chaos.parse_plan(";".join(entries))
 
@@ -588,6 +605,11 @@ def _serve_oracle(seed: int, plan_text: "str | None"):
             return ("mismatch",
                     f"{r.rid}: engine={out.get(r.rid)} oracle={want} "
                     f"plan={plan!r}")
+    eng.drain()
+    if eng.kv.pages_in_use != 0:
+        return ("leak",
+                f"{eng.kv.pages_in_use} pages live after drain "
+                f"plan={plan!r}")
     return None
 
 

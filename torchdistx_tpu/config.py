@@ -150,6 +150,14 @@ Environment variables (read at first import):
                         training stack (:mod:`torchdistx_tpu.chaos`), e.g.
                         ``"step@4=raise;save@2=corrupt:truncate"``
                         ("" disables; see docs/robustness.md).
+``TDX_PREFILL_CHUNK``   Default chunk-size cap for serving chunked prefill
+                        (:mod:`torchdistx_tpu.serve`): max prompt tokens a
+                        lane prefills per engine tick.  0 (default) means
+                        the largest prefill bucket — i.e. single-chunk for
+                        any prompt that fits a bucket.  A host-side
+                        scheduling knob: the compiled program set is
+                        identical at every setting (see docs/serving.md
+                        §Prefix sharing & chunked prefill).
 ``TDX_TRACE_PARENT``    Causal trace-context handoff (NOT a Config field —
                         read once by :mod:`torchdistx_tpu.observe.tracectx`
                         at adoption): a parent process that spawns work
@@ -202,6 +210,7 @@ class Config:
     materialize_init_dtype: Optional[str] = None
     materialize_batch_put: bool = True
     reshard_chunk_mb: float = 64.0
+    prefill_chunk: int = 0
 
 
 def _from_env() -> Config:
@@ -241,6 +250,7 @@ def _from_env() -> Config:
             os.environ.get("TDX_MATERIALIZE_BATCH_PUT", "1") != "0"
         ),
         reshard_chunk_mb=float(os.environ.get("TDX_RESHARD_CHUNK_MB", "64")),
+        prefill_chunk=int(os.environ.get("TDX_PREFILL_CHUNK", "0")),
     )
 
 

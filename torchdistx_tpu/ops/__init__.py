@@ -7,7 +7,11 @@ an interpret-mode path so the full test suite runs on CPU.
 
 from .autotune import tune_flash_blocks
 from .flash_attention import flash_attention, make_flash_attention
-from .paged_attention import paged_attention, paged_attention_reference
+from .paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    paged_prefill_attention,
+)
 from .segments import normalize_segment_ids
 
 __all__ = [
@@ -16,5 +20,6 @@ __all__ = [
     "normalize_segment_ids",
     "paged_attention",
     "paged_attention_reference",
+    "paged_prefill_attention",
     "tune_flash_blocks",
 ]

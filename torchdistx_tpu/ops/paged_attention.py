@@ -92,6 +92,45 @@ def paged_attention_reference(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def paged_prefill_attention(
+    q: jax.Array,  # [B, S, H, D] — a chunk of query tokens per sequence
+    k_pages: jax.Array,  # [P, page, KV, D]
+    v_pages: jax.Array,  # [P, page, KV, D]
+    q_positions: jax.Array,  # [B, S] int32 — absolute positions of q
+    lengths: jax.Array,  # [B] int32 — valid context INCLUDING the chunk
+    page_table: jax.Array,  # [B, max_pages] int32
+) -> jax.Array:
+    """Chunked-prefill attention through the page table: each query at
+    absolute position ``t`` attends every cached position ``<= t`` — the
+    already-written prefix pages (a shared system prompt, earlier
+    chunks) plus the chunk's own causal context, whose K/V the caller
+    scattered into the pool before calling.  Gather-based jnp like
+    :func:`paged_attention_reference`; positions at or past
+    ``lengths[b]`` are padding — their rows are garbage and must be
+    ignored by the caller (position 0 always satisfies the mask, so no
+    row softmaxes over an empty set)."""
+    B, S, H, D = q.shape
+    page = k_pages.shape[1]
+    KV = k_pages.shape[2]
+    groups = H // KV
+    maxp = page_table.shape[1]
+    T = maxp * page
+
+    k = k_pages[page_table].reshape(B, T, KV, D).astype(jnp.float32)
+    v = v_pages[page_table].reshape(B, T, KV, D).astype(jnp.float32)
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(D))
+    qf = qf.reshape(B, S, KV, groups, D)
+    logits = jnp.einsum("bskgd,btkd->bskgt", qf, k)
+    tpos = jnp.arange(T)[None, None, :]
+    mask = (tpos <= q_positions[:, :, None]) & (
+        tpos < lengths[:, None, None]
+    )  # [B, S, T]
+    logits = jnp.where(mask[:, :, None, None, :], logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bskgt,btkd->bskgd", probs, v)
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
 def _decode_kernel(
     lengths_ref,  # SMEM [B] i32 (scalar prefetch)
     table_ref,  # SMEM [B, max_pages] i32 (scalar prefetch)

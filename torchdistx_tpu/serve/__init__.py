@@ -9,6 +9,14 @@ whose decode step gathers each sequence's context through per-sequence
 page tables with the ragged paged-attention kernel
 (:mod:`torchdistx_tpu.ops.paged_attention`, arXiv:2604.15464).
 
+The hot path is prefix-aware: a radix tree over page-aligned token
+blocks (:mod:`.prefix`) maps cached prompt prefixes to live KV pages —
+a request whose preamble is cached maps those pages into its own table
+(copy-on-write, refcounted in :mod:`.kv_cache`) and prefills only its
+suffix; suffixes and oversized prompts prefill in fixed-size CHUNKS
+interleaved with decode ticks (:class:`ServeConfig.prefill_chunk`), so
+one long prompt cannot stall the whole batch.
+
 Quick tour::
 
     from torchdistx_tpu.serve import Request, spin_up_replica
@@ -49,15 +57,19 @@ from .guardrails import (
     should_hedge,
 )
 from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
+from .prefix import PrefixCache
 from .router import (
     AdmissionQueue,
     FleetRejected,
     Rejection,
     least_outstanding,
+    prefix_affinity,
 )
 from .programs import (
     ServeConfig,
     ServeProgramSpec,
+    build_chunk_prefill_fn,
+    build_cow_fn,
     build_decode_fn,
     build_prefill_fn,
     compile_serving_program,
@@ -77,6 +89,7 @@ __all__ = [
     "QuarantineEntry",
     "OutOfPages",
     "PagedKVCache",
+    "PrefixCache",
     "Rejection",
     "ReplicaHandle",
     "Request",
@@ -84,12 +97,15 @@ __all__ = [
     "ServeEngine",
     "ServeFleet",
     "ServeProgramSpec",
+    "build_chunk_prefill_fn",
+    "build_cow_fn",
     "build_decode_fn",
     "build_prefill_fn",
     "compile_serving_program",
     "init_pools",
     "least_outstanding",
     "oracle_generate",
+    "prefix_affinity",
     "serve_program_specs",
     "should_hedge",
     "spin_up_replica",

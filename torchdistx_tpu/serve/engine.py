@@ -6,15 +6,34 @@ paged KV pool (:mod:`.kv_cache`), an admission queue, and the compiled
 prefill/decode programs (:mod:`.programs`).  The loop interleaves:
 
 1. **admission** — waiting requests are admitted while a batch lane and
-   enough pages for their prompt are free; admission runs the bucketed
-   prefill program (writes the prompt's K/V into the sequence's pages,
-   emits the first token — that's the TTFT measurement point);
-2. **decode** — ONE batched step for every active lane through the
-   decode program (ragged paged attention over each lane's own context
-   length); one token per lane per step;
+   enough pages for their prompt are free; admission first consults the
+   prefix cache (:mod:`.prefix`): the longest cached page-aligned
+   prefix's pages are MAPPED into the new sequence's table
+   (``alloc_shared`` — zero prefill FLOPs for the reused tokens) and
+   only the suffix is prefilled.  A suffix that fits one chunk runs at
+   admission (that's still the TTFT point); longer suffixes prefill
+   **chunked** — ``ServeConfig.prefill_chunk`` tokens per engine tick,
+   interleaved with decode steps — which is also how prompts LARGER
+   than the largest prefill bucket serve instead of being rejected;
+2. **decode** — ONE batched step for every fully-prefilled lane through
+   the decode program (ragged paged attention over each lane's own
+   context length); one token per lane per step; mid-prefill lanes sit
+   the step out;
 3. **retirement** — lanes that hit EOS / their token budget / the
-   context cap free their pages *immediately*, so the next step's
-   admission can hand them to waiting requests.
+   context cap release their page references *immediately* (a page
+   frees when its last reference drops — shared prefix pages survive in
+   the cache), so the next step's admission can hand pages to waiting
+   requests.  A finished prefill inserts its prompt's full pages into
+   the prefix cache first, so later requests with the same preamble
+   reuse them.
+
+Shared pages are COPY-ON-WRITE: the only write a grower can aim at a
+shared page (recomputing the last prompt position of a fully-cached
+page-aligned prompt) first duplicates the page through the compiled
+``cow`` program and remaps the grower's table — a cached page's
+contents never change while anyone else can read them.  Under pool
+pressure the engine EVICTS cache leaves (LRU) before it will preempt a
+running lane.
 
 When the pool cannot cover a lane's growth the engine **preempts** the
 youngest lane (frees its pages, requeues the whole request at the front
@@ -63,6 +82,7 @@ from .. import chaos, observe
 from ..models import PRESETS, TransformerConfig
 from ..utils.logging import get_logger
 from .kv_cache import OutOfPages, PagedKVCache, init_pools
+from .prefix import PrefixCache
 from .programs import (
     ResolvedServeConfig,
     ServeConfig,
@@ -108,6 +128,7 @@ class _Lane:
     length: int = 0                # tokens currently in the KV cache
     generated: List[int] = field(default_factory=list)
     admitted_step: int = 0
+    prefilling: bool = False       # mid-chunked-prefill; decode skips it
 
 
 class ServeEngine:
@@ -145,8 +166,13 @@ class ServeEngine:
         self.cancelled: Dict[str, List[int]] = {}  # rid -> tokens at cancel
         self._draining = False
         self.kv = PagedKVCache(self.scfg.kv_config(cfg))
+        self.prefix = PrefixCache(self.kv)
         self.k_pages, self.v_pages = init_pools(self.scfg.kv_config(cfg),
                                                 cfg.dtype)
+        # Chunk-boundary chaos faults (``serve@N=raise:chunk``) are
+        # deferred here by step() and fired BETWEEN prefill chunks —
+        # the mid-chunked-prefill fault the failure matrix pins.
+        self._pending_chunk_faults: List[chaos.Fault] = []
         self._programs: Dict[str, object] = {}
         self._spec_cache: Optional[Dict[str, object]] = None
         self.waiting: deque[Request] = deque()
@@ -195,6 +221,8 @@ class ServeEngine:
                     max_pages_per_seq=self.scfg.max_pages_per_seq,
                     prefill_buckets=self.scfg.prefill_buckets,
                     max_new_tokens=self.scfg.max_new_tokens,
+                    prefill_chunk=self.scfg.prefill_chunk or None,
+                    prefix_cache=self.scfg.prefix_cache,
                 ),
                 seed=self._seed, param_dtype=self._param_dtype,
                 mesh=self.mesh, plan=self.plan,
@@ -243,15 +271,6 @@ class ServeEngine:
                 f"request {req.rid}: prompt + budget "
                 f"({len(req.tokens)} + {req.max_new_tokens}) exceeds "
                 f"max_context={self.scfg.max_context}"
-            )
-        if len(req.tokens) > self.scfg.prefill_buckets[-1]:
-            # Explicit bucket lists may cap below max_context; reject at
-            # the door — an oversized request must never dequeue and
-            # then kill the loop for everyone else.
-            raise ValueError(
-                f"request {req.rid}: prompt of {len(req.tokens)} tokens "
-                f"exceeds the largest prefill bucket "
-                f"{self.scfg.prefill_buckets[-1]}"
             )
         if not req.tokens:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -312,6 +331,10 @@ class ServeEngine:
                 )
             leftover = list(self.waiting)
             self.waiting.clear()
+            # A drained replica holds no sequences; drop the prefix
+            # cache's references too so every refcount returns to zero
+            # (the zero-leak drain contract the tests pin).
+            self.prefix.clear()
             return leftover
         finally:
             self._draining = False
@@ -396,6 +419,7 @@ class ServeEngine:
             )
         self.k_pages = self.v_pages = None
         self.kv = PagedKVCache(self.scfg.kv_config(self.cfg))
+        self.prefix = PrefixCache(self.kv)
         self._gauges()
 
     def outstanding_tokens(self) -> int:
@@ -417,9 +441,10 @@ class ServeEngine:
         return len(self.waiting) + len(self.active)  # coarse fallback
 
     def step(self) -> None:
-        """One engine tick: chaos site → admission (+prefill) → one
-        batched decode step → retirement.  A retryable runtime fault
-        mid-batch requeues every active lane (recompute preemption)."""
+        """One engine tick: chaos site → chunked-prefill advance →
+        admission (+prefill) → one batched decode step → retirement.  A
+        retryable runtime fault mid-batch requeues every active lane
+        (recompute preemption)."""
         self._step_no += 1
         if self._t0 is None:
             self._t0 = time.perf_counter()
@@ -428,10 +453,15 @@ class ServeEngine:
             active=len(self.active), waiting=len(self.waiting),
         ):
             try:
-                chaos.maybe_inject("serve", self._step_no,
-                                   plan=chaos.active_plan())
+                self._take_serve_faults()
                 self._expire_deadlines()
+                self._advance_prefill()
                 self._admit()
+                if self._pending_chunk_faults:
+                    # A chunk fault due on a step with no chunk
+                    # boundary to defer to still fires (a plan's fault
+                    # is never silently dropped).
+                    chaos.execute(self._pending_chunk_faults.pop(0))
                 self._decode_step()
             except self._retryable as e:
                 get_logger().warning(
@@ -455,6 +485,21 @@ class ServeEngine:
 
     # -- admission / prefill ------------------------------------------------
 
+    def _take_serve_faults(self) -> None:
+        """The serve chaos site, taken by hand instead of through
+        :func:`chaos.maybe_inject`: ``raise:chunk`` faults are DEFERRED
+        to the next prefill-chunk boundary (the mid-chunked-prefill
+        fault docs/serving.md's failure matrix pins); everything else
+        executes immediately, exactly as maybe_inject would."""
+        plan = chaos.active_plan()
+        if plan is None:
+            return
+        for fault in plan.take("serve", self._step_no):
+            if fault.kind == "raise" and fault.arg == "chunk":
+                self._pending_chunk_faults.append(fault)
+            else:
+                chaos.execute(fault)
+
     def _free_slot(self) -> Optional[int]:
         for s in range(self.scfg.max_batch):
             if s not in self.active:
@@ -471,14 +516,33 @@ class ServeEngine:
             slot = self._free_slot()
             if slot is None:
                 break
-            if not self.kv.can_fit(len(req.tokens)):
+            shared = (self.prefix.match(req.tokens)
+                      if self.scfg.prefix_cache else [])
+            need = self.kv.cfg.pages_for(len(req.tokens)) - len(shared)
+            # Cache leaves are strictly cheaper to give up than running
+            # lanes; evict LRU ones (never this request's own matched
+            # prefix) until the suffix fits.
+            while (need > self.kv.free_pages
+                   and self.prefix.evict(exclude=set(shared))):
+                pass
+            if need > self.kv.free_pages:
                 break  # retirement will free pages; keep FIFO order
             self.waiting.popleft()
-            self._prefill(req, slot)
+            self._prefill(req, slot, shared)
 
-    def _prefill(self, req: Request, slot: int) -> None:
+    def _chunk_cap(self) -> int:
+        # Guard for directly-constructed ResolvedServeConfigs whose
+        # prefill_chunk kept the field default 0 (resolve() always pins
+        # a positive cap).
+        return self.scfg.prefill_chunk or self.scfg.prefill_buckets[-1]
+
+    def _prefill(self, req: Request, slot: int,
+                 shared: Sequence[int]) -> None:
+        """Admit one request: map its cached prefix pages (``shared``),
+        then prefill the suffix — in one shot through the classic
+        bucketed program when it fits a single chunk, else chunk by
+        chunk across engine ticks (``_advance_prefill``)."""
         L = len(req.tokens)
-        bucket = self.scfg.bucket_for(L)
         # Queue wait = submit → the moment a lane+pages were granted.
         # A requeued (preempted/faulted) request measures from its
         # ORIGINAL submit — the client has been waiting the whole time.
@@ -488,25 +552,46 @@ class ServeEngine:
         self.slo.observe_queue_wait(wait)
         sid = self._next_seq
         self._next_seq += 1
-        self.kv.alloc(sid, L)
-        lane = _Lane(req=req, seq_id=sid, slot=slot, length=L,
-                     admitted_step=self._step_no)
+        if shared:
+            self.kv.alloc_shared(sid, shared, L)
+        else:
+            self.kv.alloc(sid, L)
+        # Reused tokens never re-prefill — but the LAST prompt position
+        # must run (its logits are the first generated token), so a
+        # fully-cached prompt recomputes exactly one token (and that
+        # write is the one copy-on-write case: it lands in a shared
+        # page).
+        start = min(len(shared) * self.scfg.page_size, L - 1)
+        if start > 0:
+            observe.counter("tdx.serve.prefix_hits").inc()
+            observe.counter("tdx.serve.prefix_tokens_reused").inc(start)
+        lane = _Lane(req=req, seq_id=sid, slot=slot, length=start,
+                     admitted_step=self._step_no, prefilling=True)
         try:
             with observe.span(
                 "serve.prefill", category="serve", rid=req.rid, tokens=L,
-                bucket=bucket,
+                reused=start,
             ):
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :L] = req.tokens
-                row = np.asarray(
-                    [self.kv.table_row(sid, self.scfg.max_pages_per_seq)],
-                    np.int32,
-                )
-                logits, self.k_pages, self.v_pages = self._program(
-                    f"prefill-{bucket}"
-                )(self.params, self.k_pages, self.v_pages, jnp.asarray(toks),
-                  jnp.asarray([L], jnp.int32), jnp.asarray(row))
-                logits = np.asarray(logits)
+                if (not shared and L <= self.scfg.prefill_buckets[-1]
+                        and L <= self._chunk_cap()):
+                    # Classic single-shot path: fresh prompt, one chunk.
+                    bucket = self.scfg.bucket_for(L)
+                    toks = np.zeros((1, bucket), np.int32)
+                    toks[0, :L] = req.tokens
+                    row = np.asarray(
+                        [self.kv.table_row(sid,
+                                           self.scfg.max_pages_per_seq)],
+                        np.int32,
+                    )
+                    logits, self.k_pages, self.v_pages = self._program(
+                        f"prefill-{bucket}"
+                    )(self.params, self.k_pages, self.v_pages,
+                      jnp.asarray(toks), jnp.asarray([L], jnp.int32),
+                      jnp.asarray(row))
+                    logits = np.asarray(logits)
+                    lane.length = L
+                else:
+                    logits = self._run_chunk(lane)  # None → more chunks
         except BaseException:
             # The request left the queue and its pages are allocated,
             # but it is not in `active` yet — step()'s fault handler
@@ -523,14 +608,111 @@ class ServeEngine:
                             step=self._step_no)
             raise
         self.active[slot] = lane
+        observe.counter("tdx.serve.prefills").inc()
+        observe.counter("tdx.serve.prefill_tokens").inc(L - start)
+        if logits is not None:
+            self._finish_prefill(lane, logits)
+
+    def _run_chunk(self, lane: _Lane) -> Optional[np.ndarray]:
+        """One prefill chunk for ``lane``: copy-on-write its first page
+        if shared, run the bucketed chunk program over the next
+        ``prefill_chunk`` prompt tokens.  Returns the final position's
+        logits when the prompt is complete, else ``None``."""
+        req = lane.req
+        L = len(req.tokens)
+        s = lane.length
+        n = min(L - s, self._chunk_cap())
+        bucket = self.scfg.bucket_for(n)
+        # Only a chunk's FIRST page can be shared (later pages were
+        # written by this very sequence's earlier chunks); cow_page
+        # no-ops at refcount 1, so this is unconditional.
+        self._cow_for(lane, s // self.scfg.page_size)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = req.tokens[s:s + n]
+        row = np.asarray(
+            [self.kv.table_row(lane.seq_id, self.scfg.max_pages_per_seq)],
+            np.int32,
+        )
+        logits, self.k_pages, self.v_pages = self._program(
+            f"chunk-{bucket}"
+        )(self.params, self.k_pages, self.v_pages, jnp.asarray(toks),
+          jnp.asarray([s], jnp.int32), jnp.asarray([s + n], jnp.int32),
+          jnp.asarray(row))
+        lane.length = s + n
+        observe.counter("tdx.serve.prefill_chunks").inc()
+        if lane.length >= L:
+            return np.asarray(logits)
+        return None
+
+    def _cow_for(self, lane: _Lane, page_index: int) -> None:
+        """Give ``lane`` a private copy of its ``page_index``-th page if
+        that page is shared, cloning the contents through the compiled
+        ``cow`` program.  Under pool exhaustion: evict cache leaves,
+        then preempt the youngest OTHER lane — each preemption/eviction
+        drops references, so the loop always terminates (worst case the
+        refcount falls to 1 and the copy becomes unnecessary)."""
+        while True:
+            try:
+                moved = self.kv.cow_page(lane.seq_id, page_index)
+                break
+            except OutOfPages:
+                if self.prefix.evict():
+                    continue
+                victim = self._youngest_other(lane)
+                if victim is not None:
+                    self._preempt(victim, reason="pages")
+                    continue
+                raise  # pragma: no cover — ref>1 implies an evictee
+        if moved is not None:
+            src, dst = moved
+            self.k_pages, self.v_pages = self._program("cow")(
+                self.k_pages, self.v_pages,
+                jnp.asarray([src], jnp.int32), jnp.asarray([dst], jnp.int32),
+            )
+            observe.counter("tdx.serve.cow_copies").inc()
+
+    def _youngest_other(self, lane: _Lane) -> Optional[int]:
+        others = [s for s in self.active if s != lane.slot]
+        if not others:
+            return None
+        return max(others, key=lambda s: (self.active[s].admitted_step, s))
+
+    def _advance_prefill(self) -> None:
+        """One chunk for every mid-prefill lane — chunked prefill
+        interleaves with decode at engine-tick granularity, so a long
+        prompt cannot lock the batch out for its whole prefill.  A
+        deferred ``raise:chunk`` chaos fault fires HERE, between
+        chunks."""
+        for slot in sorted(self.active):
+            lane = self.active.get(slot)
+            if lane is None or not lane.prefilling:
+                continue
+            if self._pending_chunk_faults:
+                chaos.execute(self._pending_chunk_faults.pop(0))
+            logits = self._run_chunk(lane)
+            if logits is not None:
+                self._finish_prefill(lane, logits)
+
+    def _finish_prefill(self, lane: _Lane, logits: np.ndarray) -> None:
+        """The prompt's K/V is fully written: publish its full pages to
+        the prefix cache (BEFORE the first emit — retirement may free
+        the sequence immediately, and the cache's references are what
+        keep the pages alive), then deliver the first token (TTFT)."""
+        lane.prefilling = False
+        req = lane.req
+        L = len(req.tokens)
+        nfull = L // self.scfg.page_size
+        if nfull and self.scfg.prefix_cache:
+            self.prefix.insert(
+                req.tokens[:nfull * self.scfg.page_size],
+                self.kv.page_ids(lane.seq_id)[:nfull],
+            )
         # A re-prefill after preemption replays a first token the client
         # already received — it must not contribute a (huge, bogus) TTFT
         # sample; prefills/prefill_tokens keep counting, they measure
         # engine work, not delivery.
         first_delivery = self._delivered.get(req.rid, 0) == 0
         self._emit(lane, int(np.argmax(logits)), logits)
-        observe.counter("tdx.serve.prefills").inc()
-        observe.counter("tdx.serve.prefill_tokens").inc(L)
         if first_delivery:
             ttft = time.perf_counter() - getattr(req, "_submit_t",
                                                  time.perf_counter())
@@ -539,19 +721,27 @@ class ServeEngine:
 
     # -- decode ---------------------------------------------------------------
 
+    def _decodable(self) -> List[int]:
+        return [s for s in sorted(self.active)
+                if not self.active[s].prefilling]
+
     def _ensure_capacity(self) -> None:
-        """Every active lane must own a page slot for its next token;
-        preempt the youngest lanes until the pool covers the rest."""
+        """Every decoding lane must own a page slot for its next token;
+        evict prefix-cache leaves first, then preempt the youngest
+        lanes, until the pool covers the rest.  Mid-prefill lanes sit
+        decode out — their growth is the chunk path's business."""
         for slot in sorted(self.active,
                            key=lambda s: (self.active[s].admitted_step, s)):
             lane = self.active.get(slot)
-            if lane is None:
+            if lane is None or lane.prefilling:
                 continue
             while True:
                 try:
                     self.kv.extend(lane.seq_id, lane.length + 1)
                     break
                 except OutOfPages:
+                    if self.prefix.evict():
+                        continue
                     victim = max(
                         self.active,
                         key=lambda s: (self.active[s].admitted_step, s),
@@ -561,10 +751,11 @@ class ServeEngine:
                         break  # this lane itself was the youngest
 
     def _decode_step(self) -> None:
-        if not self.active:
+        if not self._decodable():
             return
         self._ensure_capacity()
-        if not self.active:
+        slots = self._decodable()
+        if not slots:
             return
         t_step = time.perf_counter()
         B = self.scfg.max_batch
@@ -572,11 +763,16 @@ class ServeEngine:
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         table = np.zeros((B, maxp), np.int32)
-        for slot, lane in self.active.items():
+        # One batched table build for the whole tick (the per-lane
+        # Python loop was the decode hot path's host-side tax).
+        table[slots] = self.kv.table_rows(
+            [self.active[s].seq_id for s in slots], maxp
+        )
+        for slot in slots:
+            lane = self.active[slot]
             tokens[slot] = (lane.generated[-1] if lane.generated
                             else lane.req.tokens[-1])
             positions[slot] = lane.length
-            table[slot] = self.kv.table_row(lane.seq_id, maxp)
         logits, self.k_pages, self.v_pages = self._program("decode")(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(table),
@@ -587,12 +783,14 @@ class ServeEngine:
         # sample PER LANE, so the distribution weights a 4-wide step as
         # the four token deliveries it was.
         dt = time.perf_counter() - t_step
-        n_lanes = len(self.active)
+        n_lanes = len(slots)
         if n_lanes:
             self._tok_hist.observe(dt, n=n_lanes)
             self.slo.observe_token_latency(dt, n=n_lanes)
-        for slot in list(self.active):
-            lane = self.active[slot]
+        for slot in slots:
+            lane = self.active.get(slot)
+            if lane is None:  # pragma: no cover — nothing retires mid-loop
+                continue
             lane.length += 1
             self._emit(lane, int(np.argmax(logits[slot])), logits[slot])
         observe.counter("tdx.serve.decode_steps").inc()

@@ -16,11 +16,14 @@ replicas, routes traffic, and scales.  This module is that layer:
   other.
 * **Router** (:mod:`.router`) — one bounded global
   :class:`~.router.AdmissionQueue` (overflow and per-request deadline →
-  typed :class:`~.router.Rejection`), least-outstanding-WORK dispatch
-  (:func:`~.router.least_outstanding` over remaining token budget) over
-  the ready replicas, with a per-replica dispatch cap so backlog builds
-  in the global queue (where the autoscaler can see it) instead of
-  deep inside one replica.
+  typed :class:`~.router.Rejection`), prefix-affinity dispatch
+  (:func:`~.router.prefix_affinity`: prefer the ready replica whose
+  prefix cache already holds the request's preamble — counted as
+  ``tdx.fleet.prefix_affinity_hits`` — falling back to
+  least-outstanding-WORK over remaining token budget) with a
+  per-replica dispatch cap so backlog builds in the global queue
+  (where the autoscaler can see it) instead of deep inside one
+  replica.
 * **Autoscaler** — SLO-driven, pure, and hysteretic: scale up on
   sustained queue-depth or p95-TTFT pressure (read from the replicas'
   :mod:`..observe.slo` windows), scale down by DRAINING — a draining
@@ -103,7 +106,13 @@ from .guardrails import (
     should_hedge,
 )
 from .programs import ServeConfig, model_family
-from .router import AdmissionQueue, FleetRejected, Rejection, least_outstanding
+from .router import (
+    AdmissionQueue,
+    FleetRejected,
+    Rejection,
+    least_outstanding,
+    prefix_affinity,
+)
 
 __all__ = ["Autoscaler", "FleetConfig", "ReplicaHandle", "ServeFleet"]
 
@@ -234,6 +243,20 @@ class ReplicaHandle:
         if eng is not None:
             load += eng.outstanding_tokens()
         return load
+
+    def prefix_match_tokens(self, tokens) -> int:
+        """How many of ``tokens`` this replica's prefix cache already
+        holds — the router-affinity probe.  Called from the CONTROLLER
+        thread against the replica's live tree; the probe is
+        mutation-free and any cross-thread artifact reads as 0 (it's a
+        routing heuristic, never an invariant)."""
+        eng = self.engine
+        if eng is None or not eng.scfg.prefix_cache:
+            return 0
+        try:
+            return eng.prefix.match_len(tokens)
+        except Exception:  # noqa: BLE001 — a stale probe must not kill a tick
+            return 0
 
     def note_fault(self, kind: str) -> None:
         """Record one breaker observation from the replica thread; the
@@ -404,9 +427,6 @@ class ServeFleet:
             return (f"prompt + budget ({len(req.tokens)} + "
                     f"{req.max_new_tokens}) exceeds "
                     f"max_context={self._resolved.max_context}")
-        if len(req.tokens) > self._resolved.prefill_buckets[-1]:
-            return (f"prompt of {len(req.tokens)} tokens exceeds the largest "
-                    f"prefill bucket {self._resolved.prefill_buckets[-1]}")
         return None
 
     def _reject(self, rejection: Rejection) -> None:
@@ -782,7 +802,12 @@ class ServeFleet:
                 # rejection carrying whatever was already delivered.
                 self._reject_deadline(req.rid, where="dispatch")
                 continue
-            h = least_outstanding(ready, lambda x: x.outstanding())
+            h, affine = prefix_affinity(
+                ready, lambda x: x.outstanding(),
+                lambda x: x.prefix_match_tokens(req.tokens),
+            )
+            if affine:
+                observe.counter("tdx.fleet.prefix_affinity_hits").inc()
             h.give(req)
             if self.gc is not None and len(ready) > 1:
                 waited = now - entry.enqueued_t

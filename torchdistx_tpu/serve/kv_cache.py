@@ -28,6 +28,18 @@ fixed-shape program never touch a live sequence's memory and need no
 masking in the scatter.  The null page is never handed out and never
 read (idle lanes carry ``length == 0``).
 
+**Prefix sharing** (:mod:`.prefix`) makes pages multi-reader: every
+allocated page carries a host-side **refcount** — one reference per
+live page table that maps it plus one per prefix-cache node that holds
+it.  :meth:`PagedKVCache.alloc_shared` admits a sequence whose leading
+pages are another prompt's already-written prefix (the shared pages'
+refcounts rise, only the suffix allocates fresh pages);
+:meth:`PagedKVCache.free` decrements and returns a page to the free
+list only when its count hits zero; and :meth:`PagedKVCache.cow_page`
+is the copy-on-write step — a sequence about to WRITE into a page it
+shares swaps in a fresh page first (the engine device-copies the
+contents), so no reader of a shared page ever observes a mutation.
+
 Telemetry (docs/observability.md): ``tdx.serve.kv_pages_in_use``,
 ``tdx.serve.kv_occupancy`` (used token slots / allocated slots in live
 pages — the internal-fragmentation complement), and
@@ -37,7 +49,9 @@ pages — the internal-fragmentation complement), and
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from .. import observe
 
@@ -106,6 +120,10 @@ class PagedKVCache:
         # pool slices are most likely still warm in device caches).
         self._free: List[int] = list(range(cfg.n_pages - 1, 0, -1))
         self._seqs: Dict[int, _Seq] = {}
+        # Per-page refcounts: one reference per live page table mapping
+        # the page, plus one per prefix-cache node holding it.  A page
+        # returns to the free list only at refcount zero.
+        self._ref: Dict[int, int] = {}
         self._update_gauges()
 
     # -- queries ------------------------------------------------------------
@@ -126,6 +144,10 @@ class PagedKVCache:
 
     def has(self, seq_id: int) -> bool:
         return seq_id in self._seqs
+
+    def ref(self, page: int) -> int:
+        """The page's current refcount (0 for free/unknown pages)."""
+        return self._ref.get(page, 0)
 
     def occupancy(self) -> float:
         """Used token slots / allocated slots in live pages (1.0 = no
@@ -160,9 +182,96 @@ class PagedKVCache:
                 f"{len(self._free)} free"
             )
         pages = [self._free.pop() for _ in range(need)]
+        for p in pages:
+            self._ref[p] = 1
         self._seqs[seq_id] = _Seq(pages=pages, length=n_tokens)
         self._update_gauges()
         return list(pages)
+
+    def alloc_shared(self, seq_id: int, shared_pages: Sequence[int],
+                     n_tokens: int) -> List[int]:
+        """Allocate a sequence whose LEADING pages are another prompt's
+        already-written prefix: the shared pages' refcounts rise (their
+        contents are never rewritten without :meth:`cow_page`), fresh
+        pages cover only the suffix.  Returns the full page table.
+        Raises :class:`OutOfPages` changing nothing when the free list
+        cannot cover the suffix."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        shared = list(shared_pages)
+        need = self.cfg.pages_for(n_tokens) - len(shared)
+        if need < 0:
+            raise ValueError(
+                f"{len(shared)} shared pages exceed the "
+                f"{self.cfg.pages_for(n_tokens)} pages {n_tokens} tokens "
+                f"need"
+            )
+        for p in shared:
+            if self._ref.get(p, 0) < 1:
+                raise ValueError(f"shared page {p} is not allocated")
+        if need > len(self._free):
+            raise OutOfPages(
+                f"need {need} fresh pages for {n_tokens} tokens "
+                f"({len(shared)} shared), {len(self._free)} free"
+            )
+        for p in shared:
+            self._ref[p] += 1
+        fresh = [self._free.pop() for _ in range(need)]
+        for p in fresh:
+            self._ref[p] = 1
+        self._seqs[seq_id] = _Seq(pages=shared + fresh, length=n_tokens)
+        self._update_gauges()
+        return shared + fresh
+
+    def retain(self, pages: Iterable[int]) -> None:
+        """Add one reference to each page (the prefix cache holding a
+        prompt's pages past the sequence's lifetime)."""
+        for p in pages:
+            if self._ref.get(p, 0) < 1:
+                raise ValueError(f"cannot retain free page {p}")
+            self._ref[p] += 1
+
+    def release(self, pages: Iterable[int]) -> int:
+        """Drop one reference from each page, returning those that hit
+        zero to the free list; returns how many pages were freed."""
+        freed = []
+        for p in pages:
+            n = self._ref.get(p, 0)
+            if n < 1:
+                raise ValueError(f"cannot release free page {p}")
+            if n == 1:
+                del self._ref[p]
+                freed.append(p)
+            else:
+                self._ref[p] = n - 1
+        if freed:
+            self._free.extend(reversed(freed))
+            self._update_gauges()
+        return len(freed)
+
+    def cow_page(self, seq_id: int,
+                 page_index: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write: the sequence is about to WRITE into the page at
+        ``page_index`` of its table.  Exclusively-owned pages need
+        nothing (returns ``None``); a shared page is swapped for a fresh
+        one — the caller must device-copy src → dst before writing —
+        and the caller's reference moves to the copy.  Returns
+        ``(src, dst)`` page ids, or raises :class:`OutOfPages` (changing
+        nothing) when no fresh page is free."""
+        seq = self._seqs[seq_id]
+        src = seq.pages[page_index]
+        if self._ref[src] == 1:
+            return None
+        if not self._free:
+            raise OutOfPages(
+                f"sequence {seq_id} needs a copy-on-write page, 0 free"
+            )
+        dst = self._free.pop()
+        self._ref[src] -= 1
+        self._ref[dst] = 1
+        seq.pages[page_index] = dst
+        self._update_gauges()
+        return src, dst
 
     def extend(self, seq_id: int, new_length: int) -> List[int]:
         """Grow ``seq_id`` to hold ``new_length`` tokens, allocating at
@@ -181,6 +290,8 @@ class PagedKVCache:
                 f"{len(self._free)} free"
             )
         added = [self._free.pop() for _ in range(max(0, need))]
+        for p in added:
+            self._ref[p] = 1
         seq.pages.extend(added)
         seq.length = new_length
         if added:
@@ -188,20 +299,33 @@ class PagedKVCache:
         return added
 
     def free(self, seq_id: int) -> int:
-        """Retire a sequence, returning its pages to the free list;
-        returns how many pages were freed.  Unknown ids are a no-op
+        """Retire a sequence, dropping one reference from each of its
+        pages; pages whose refcount hits zero return to the free list
+        (shared prefix pages survive for their other readers).  Returns
+        how many pages were actually freed.  Unknown ids are a no-op
         (retire paths race with preemption paths by design)."""
         seq = self._seqs.pop(seq_id, None)
         if seq is None:
             return 0
-        self._free.extend(reversed(seq.pages))
+        freed = []
+        for p in seq.pages:
+            if self._ref[p] == 1:
+                del self._ref[p]
+                freed.append(p)
+            else:
+                self._ref[p] -= 1
+        self._free.extend(reversed(freed))
         self._update_gauges()
-        return len(seq.pages)
+        return len(freed)
 
     def reset(self) -> None:
-        """Free every sequence (replica drain)."""
-        for sid in list(self._seqs):
-            self.free(sid)
+        """Free every sequence and every outstanding reference (replica
+        drain): one free-list rebuild and one gauge refresh, not N
+        :meth:`free` calls."""
+        self._seqs.clear()
+        self._ref.clear()
+        self._free = list(range(self.cfg.n_pages - 1, 0, -1))
+        self._update_gauges()
 
     # -- batch views --------------------------------------------------------
 
@@ -215,6 +339,22 @@ class PagedKVCache:
                 f"max_pages={max_pages}"
             )
         return pages + [0] * (max_pages - len(pages))
+
+    def table_rows(self, seq_ids: Sequence[int],
+                   max_pages: int) -> np.ndarray:
+        """The batched decode operand: one null-padded page-table row
+        per sequence, built in a single pass ([len(seq_ids), max_pages]
+        int32) instead of a per-lane Python loop on the decode tick."""
+        rows = np.zeros((len(seq_ids), max_pages), np.int32)
+        for i, sid in enumerate(seq_ids):
+            pages = self._seqs[sid].pages
+            if len(pages) > max_pages:
+                raise ValueError(
+                    f"sequence {sid} holds {len(pages)} pages > "
+                    f"max_pages={max_pages}"
+                )
+            rows[i, :len(pages)] = pages
+        return rows
 
     # -- telemetry ----------------------------------------------------------
 

@@ -1,0 +1,193 @@
+"""Copy-on-write prefix sharing: a radix tree over page-aligned token
+blocks mapping cached prompt prefixes to live KV pages.
+
+The serving workload is dominated by shared prompt prefixes (system
+prompts, few-shot preambles): without sharing, a thousand requests with
+one system prompt pay its prefill a thousand times.  The paged pool's
+page-table indirection (:mod:`.kv_cache`, arXiv:2604.15464) makes the
+fix structural: K/V for a token block lives in a page, a page id can
+appear in ANY sequence's table, and the prefill/decode programs already
+gather through the table — so reusing a cached prefix is pure host-side
+bookkeeping, zero recompute, zero program changes.
+
+This module is that bookkeeping.  A :class:`PrefixCache` is a radix
+tree whose edges are ``page_size``-token blocks and whose nodes each
+hold ONE pool page — the K/V of that block, prefilled once by whichever
+sequence inserted it.  The cache owns one refcount reference per node
+(:meth:`PagedKVCache.retain`), so cached pages survive their inserting
+sequence's retirement; a sequence admitted through
+:meth:`PagedKVCache.alloc_shared` adds its own reference per mapped
+page.  The copy-on-write contract lives in the allocator
+(:meth:`PagedKVCache.cow_page`): a grower about to write into a shared
+page swaps in a private copy first, so a cached page's contents are
+immutable while anyone else can read them.
+
+Only FULL pages enter the tree (a partial tail page is still writable
+by its owning sequence, so it can never be shared), which keeps every
+match page-aligned by construction.  Eviction is LRU over leaf nodes,
+driven by the engine under pool pressure — dropping a leaf releases one
+page reference, never touches live sequences, and is always preferred
+over preempting a running lane.
+
+:meth:`match_len` is the router-affinity probe (:mod:`.router`): the
+fleet controller calls it across threads against a serving replica's
+live tree, so it mutates nothing and treats any concurrent-mutation
+artifact as "no match".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["PrefixCache"]
+
+
+@dataclass
+class _Node:
+    """One cached token block: ``key`` is its ``page_size``-token edge,
+    ``page`` the pool page holding its K/V."""
+
+    key: Tuple[int, ...]
+    page: int
+    parent: Optional["_Node"]  # None for first-block nodes
+    children: Dict[Tuple[int, ...], "_Node"] = field(default_factory=dict)
+    last_used: int = 0
+
+
+class PrefixCache:
+    """Radix tree over page-aligned token prefixes; see the module
+    docstring.  All mutation happens on the engine's serving thread;
+    only :meth:`match_len` is read across threads."""
+
+    def __init__(self, kv: PagedKVCache):
+        self.kv = kv
+        self.page_size = kv.cfg.page_size
+        self._children: Dict[Tuple[int, ...], _Node] = {}
+        self._tick = 0
+        self._count = 0
+
+    # -- queries ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._count
+
+    def page_count(self) -> int:
+        return self._count
+
+    def pages(self) -> List[int]:
+        """Every page the tree holds a reference on."""
+        out: List[int] = []
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            out.append(n.page)
+            stack.extend(n.children.values())
+        return out
+
+    def _blocks(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        return [tuple(tokens[i:i + ps])
+                for i in range(0, (len(tokens) // ps) * ps, ps)]
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """The pages of the longest cached page-aligned prefix of
+        ``tokens``, in order (possibly empty); touches the matched path
+        for LRU."""
+        self._tick += 1
+        pages: List[int] = []
+        children = self._children
+        for key in self._blocks(tokens):
+            node = children.get(key)
+            if node is None:
+                break
+            node.last_used = self._tick
+            pages.append(node.page)
+            children = node.children
+        return pages
+
+    def match_len(self, tokens: Sequence[int]) -> int:
+        """Matched-prefix length in TOKENS, mutation-free and safe to
+        call from another thread against a live tree (a concurrent
+        mutation can cost accuracy, never a crash) — the fleet router's
+        affinity signal."""
+        n = 0
+        try:
+            children = self._children
+            for key in self._blocks(tokens):
+                node = children.get(key)
+                if node is None:
+                    break
+                n += self.page_size
+                children = node.children
+        except RuntimeError:  # dict resized mid-iteration on a hot tree
+            return n
+        return n
+
+    # -- mutations ----------------------------------------------------------
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Cache a fully-prefilled prompt prefix: ``pages[i]`` holds the
+        K/V of the ``i``-th full block of ``tokens``.  Blocks already
+        cached keep their existing page (the inserter mapped that very
+        page via :meth:`match` + ``alloc_shared``); each NEW node
+        retains its page.  Returns how many new blocks were cached."""
+        self._tick += 1
+        blocks = self._blocks(tokens)
+        if len(pages) < len(blocks):
+            raise ValueError(
+                f"{len(blocks)} full blocks need {len(blocks)} pages, "
+                f"got {len(pages)}"
+            )
+        added = 0
+        parent: Optional[_Node] = None
+        children = self._children
+        for key, page in zip(blocks, pages):
+            node = children.get(key)
+            if node is None:
+                self.kv.retain([page])
+                node = _Node(key=key, page=page, parent=parent)
+                children[key] = node
+                self._count += 1
+                added += 1
+            node.last_used = self._tick
+            parent = node
+            children = node.children
+        return added
+
+    def evict(self, exclude: Optional[Set[int]] = None) -> bool:
+        """Drop the least-recently-used LEAF (releasing its page
+        reference); ``exclude`` protects pages a caller is mid-way
+        through mapping.  Returns whether anything was evicted — the
+        engine loops this under pool pressure before it will preempt a
+        lane."""
+        victim: Optional[_Node] = None
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif exclude is not None and n.page in exclude:
+                continue
+            elif victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        siblings = (victim.parent.children if victim.parent is not None
+                    else self._children)
+        del siblings[victim.key]
+        self._count -= 1
+        self.kv.release([victim.page])
+        return True
+
+    def clear(self) -> int:
+        """Release every cached page (drain / release_kv); returns how
+        many references were dropped."""
+        pages = self.pages()
+        if pages:
+            self.kv.release(pages)
+        self._children = {}
+        self._count = 0
+        return len(pages)

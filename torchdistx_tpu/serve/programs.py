@@ -15,7 +15,17 @@ byte-identical programs:
   position's logits (the first generated token);
 * **decode** — one token per batch lane through the stack, K/V scattered
   into each lane's current page/slot, context attended through the page
-  table via :func:`torchdistx_tpu.ops.paged_attention`, logits out.
+  table via :func:`torchdistx_tpu.ops.paged_attention`, logits out;
+* **chunk-<bucket>** — one CHUNK of a prompt (suffix after a cached
+  prefix, or one slice of a long prompt) at an arbitrary start
+  position, attending the already-written pool context through the
+  page table (:func:`torchdistx_tpu.ops.paged_attention.
+  paged_prefill_attention`) — the program chunked prefill and
+  prefix-reuse suffixes run, one per prefill bucket so chunk shapes
+  bucket exactly like prompts do;
+* **cow** — the copy-on-write page duplication: clone one pool page
+  (all layers, K and V) into a fresh page before a grower writes into
+  a shared one.
 
 Every compile goes through
 :func:`..jax_bridge.materialize._compile_program`, so the pod-scale
@@ -51,13 +61,15 @@ from .. import abstract, chaos, observe
 from .. import config as tdx_config
 from ..models import TransformerConfig, make_gpt2, make_llama
 from ..models.layers import MLP, apply_rope, default_attention, make_norm
-from ..ops import paged_attention
+from ..ops import paged_attention, paged_prefill_attention
 from ..utils.logging import get_logger
 from .kv_cache import KVCacheConfig
 
 __all__ = [
     "ServeConfig",
     "ServeProgramSpec",
+    "build_chunk_prefill_fn",
+    "build_cow_fn",
     "build_decode_fn",
     "build_prefill_fn",
     "compile_serving_program",
@@ -81,6 +93,14 @@ class ServeConfig:
     max_pages_per_seq: Optional[int] = None  # default: fits max_seq_len
     prefill_buckets: Tuple[int, ...] = ()    # default: powers of two
     max_new_tokens: int = 16    # default per-request budget
+    # Chunked-prefill cap: max prompt tokens computed per engine tick
+    # per lane (None → TDX_PREFILL_CHUNK → the largest bucket, i.e. one
+    # chunk).  A HOST-side scheduling knob: the compiled program set is
+    # identical at every setting.
+    prefill_chunk: Optional[int] = None
+    # Prefix-sharing toggle (serve/prefix.py).  Host-side too: both
+    # bench arms run the same registry-warmed programs.
+    prefix_cache: bool = True
 
     def resolve(self, cfg: TransformerConfig) -> "ResolvedServeConfig":
         page = self.page_size
@@ -99,10 +119,17 @@ class ServeConfig:
             buckets = tuple(sorted(set(acc)))
         else:
             buckets = tuple(sorted({min(b, max_context) for b in buckets}))
+        chunk = self.prefill_chunk
+        if chunk is None:
+            chunk = tdx_config.get().prefill_chunk
+        if chunk is None or chunk <= 0:
+            chunk = buckets[-1]
+        chunk = max(1, min(chunk, buckets[-1]))
         return ResolvedServeConfig(
             max_batch=self.max_batch, page_size=page, n_pages=self.n_pages,
             max_pages_per_seq=maxp, prefill_buckets=buckets,
             max_new_tokens=self.max_new_tokens, max_context=max_context,
+            prefill_chunk=chunk, prefix_cache=self.prefix_cache,
         )
 
 
@@ -118,6 +145,8 @@ class ResolvedServeConfig:
     prefill_buckets: Tuple[int, ...]
     max_new_tokens: int
     max_context: int
+    prefill_chunk: int = 0      # resolved chunk cap (host-side knob)
+    prefix_cache: bool = True   # prefix sharing armed (host-side knob)
 
     def kv_config(self, cfg: TransformerConfig) -> KVCacheConfig:
         return KVCacheConfig(
@@ -248,6 +277,36 @@ def _prefill_block(cfg, blk, x, kp, vp, *, angles, positions, length,
     return x, kp, vp
 
 
+def _chunk_block(cfg, blk, x, kp, vp, *, angles, positions, end,
+                 page_table):
+    """One layer of CHUNKED prefill: x [B, S, d] holds prompt positions
+    ``[start, start+S)``; valid positions' K/V scatter into their pages
+    (the caller already copy-on-wrote any shared first page), and
+    attention runs through the page table over the WHOLE written
+    context — cached prefix pages, earlier chunks, and this chunk's
+    causal self-context — which is what lets a suffix prefill skip the
+    prefix's FLOPs entirely."""
+    n0, n1 = _norm_keys(cfg)
+    page_size = kp.shape[1]
+    maxp = page_table.shape[1]
+    h = make_norm(cfg).apply({"params": blk[n0]}, x)
+    q, k, v = _qkv(cfg, blk["attn"], h)
+    if angles is not None:
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+    valid = positions < end[:, None]  # [B, S] absolute-position validity
+    pidx = jnp.minimum(positions // page_size, maxp - 1)
+    page = jnp.where(valid, jnp.take_along_axis(page_table, pidx, axis=1), 0)
+    slot = jnp.where(valid, positions % page_size, 0)
+    kp = kp.at[page, slot].set(k)
+    vp = vp.at[page, slot].set(v)
+    attn = paged_prefill_attention(q, kp, vp, positions, end, page_table)
+    x = x + _attn_out(cfg, blk["attn"], attn)
+    h2 = make_norm(cfg).apply({"params": blk[n1]}, x)
+    x = x + _mlp(cfg, blk, h2)
+    return x, kp, vp
+
+
 def _scan_blocks(decomp, p, x, k_pages, v_pages, block_step):
     """Thread x through the scan-stacked layers; the per-layer pool
     slices ride the scan as mapped inputs/outputs, so the whole stack's
@@ -329,6 +388,59 @@ def build_prefill_fn(family: str, cfg: TransformerConfig,
         return logits, k_pages, v_pages
 
     return prefill_fn
+
+
+def build_chunk_prefill_fn(family: str, cfg: TransformerConfig,
+                           scfg: ResolvedServeConfig, bucket: int) -> Callable:
+    """The single-sequence CHUNK prefill program for one chunk bucket:
+    ``(params, k_pages, v_pages, tokens [1, bucket], start [1], end [1],
+    page_table [1, maxp]) -> (logits [vocab], k_pages, v_pages)``.
+    ``tokens`` holds prompt positions ``[start, end)`` left-aligned
+    (padded past ``end - start``); attention reads the whole written
+    context — cached prefix pages and earlier chunks — through the page
+    table, so a suffix behind a shared prefix costs only its own FLOPs.
+    Logits are the last valid position's: meaningful (the first
+    generated token) only on the final chunk, ignored otherwise."""
+    decomp = make_model(family, cfg).decode_decomposition()
+
+    def chunk_fn(params, k_pages, v_pages, tokens, start, end, page_table):
+        p = params["params"]
+        S = tokens.shape[1]
+        positions = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+        x = decomp.embed(p, tokens, positions)
+        angles = decomp.angles_at(positions)
+
+        def step(blk, x, kp, vp):
+            return _chunk_block(
+                cfg, blk, x, kp, vp, angles=angles, positions=positions,
+                end=end, page_table=page_table,
+            )
+
+        x, k_pages, v_pages = _scan_blocks(
+            decomp, p, x, k_pages, v_pages, step
+        )
+        last = jnp.clip(end - 1 - start, 0, S - 1)[:, None, None]
+        x_last = jnp.take_along_axis(x, jnp.broadcast_to(
+            last, (x.shape[0], 1, x.shape[2])), axis=1)
+        logits = decomp.head(p, x_last)[0, 0]  # [vocab]
+        return logits, k_pages, v_pages
+
+    return chunk_fn
+
+
+def build_cow_fn() -> Callable:
+    """The copy-on-write page duplication program:
+    ``(k_pages, v_pages, src [1], dst [1]) -> (k_pages, v_pages)`` —
+    clone page ``src`` into ``dst`` across every layer, K and V, so a
+    grower about to write into a shared page writes into its private
+    copy instead.  Pure pool-to-pool; no params, one donated update."""
+
+    def cow_fn(k_pages, v_pages, src, dst):
+        k_pages = k_pages.at[:, dst[0]].set(k_pages[:, src[0]])
+        v_pages = v_pages.at[:, dst[0]].set(v_pages[:, src[0]])
+        return k_pages, v_pages
+
+    return cow_fn
 
 
 # ---------------------------------------------------------------------------
@@ -506,6 +618,29 @@ def serve_program_specs(
             program_fp=_fp(f"prefill-{b}", family, cfg, scfg, extra),
             init_options=False,
         ))
+    for b in (buckets if buckets is not None else scfg.prefill_buckets):
+        specs.append(ServeProgramSpec(
+            name=f"chunk-{b}",
+            fn=build_chunk_prefill_fn(family, cfg, scfg, b),
+            args=(params_abs, pool_sds, pool_sds,
+                  jax.ShapeDtypeStruct((1, b), i32),
+                  jax.ShapeDtypeStruct((1,), i32),
+                  jax.ShapeDtypeStruct((1,), i32),
+                  jax.ShapeDtypeStruct((1, maxp), i32)),
+            out_shardings=None,
+            program_fp=_fp(f"chunk-{b}", family, cfg, scfg, extra),
+            init_options=False,
+        ))
+    specs.append(ServeProgramSpec(
+        name="cow",
+        fn=build_cow_fn(),
+        args=(pool_sds, pool_sds,
+              jax.ShapeDtypeStruct((1,), i32),
+              jax.ShapeDtypeStruct((1,), i32)),
+        out_shardings=None,
+        program_fp=_fp("cow", family, cfg, scfg, extra),
+        init_options=False,
+    ))
     specs.append(ServeProgramSpec(
         name="decode",
         fn=build_decode_fn(family, cfg, scfg),

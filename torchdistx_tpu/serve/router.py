@@ -19,6 +19,12 @@ engine-free so its policies are testable as plain data structures:
   "as busy" as one 2-token ping.  Ties break by listing order, which the
   fleet keeps stable (replica launch order) so the policy is
   deterministic under test.
+* :func:`prefix_affinity` — the prefix-aware dispatch policy layered on
+  top: prefer the replica whose prefix cache (:mod:`.prefix`) holds the
+  longest cached prefix of the request's tokens (its prefill skips
+  those tokens' FLOPs entirely), falling back to least-outstanding-work
+  among equals — so a fleet of replicas converges to routing each
+  shared preamble at the replica that already paid for it.
 
 The queue is thread-safe (callers submit from any thread; the fleet
 controller drains it from its tick loop); the dispatch policy is pure.
@@ -40,6 +46,7 @@ __all__ = [
     "QueueEntry",
     "Rejection",
     "least_outstanding",
+    "prefix_affinity",
 ]
 
 REJECT_REASONS = ("queue_full", "deadline", "invalid", "shed")
@@ -212,3 +219,24 @@ def least_outstanding(
         if best is None or key < best:
             best, pick = key, h
     return pick
+
+
+def prefix_affinity(
+    candidates: Sequence[H],
+    load: Callable[[H], int],
+    match_len: Callable[[H], int],
+) -> Tuple[Optional[H], bool]:
+    """Prefix-aware dispatch: the candidate with the LONGEST cached
+    prefix of the request (``match_len``, in tokens), ties broken by
+    least outstanding work then listing order — with no cached prefix
+    anywhere this degenerates to exactly :func:`least_outstanding`.
+    Returns ``(pick, hit)``: ``hit`` is True when the pick actually had
+    a cached prefix (the ``tdx.fleet.prefix_affinity_hits`` signal).
+    Pure — the fleet passes thread-safe probes into live replicas."""
+    best: Optional[Tuple[int, int, int]] = None
+    pick: Optional[H] = None
+    for i, h in enumerate(candidates):
+        key = (-match_len(h), load(h), i)
+        if best is None or key < best:
+            best, pick = key, h
+    return pick, bool(best is not None and best[0] < 0)
