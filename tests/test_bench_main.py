@@ -105,6 +105,24 @@ _HOST_PHASES = {
         "chunked_short_ttft_fine_s": 0.0091,
         "prefix_chunked_short_ttft_improvement": 1.31, "oracle_equal": True,
         "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
+    "serving_ledger": {
+        "storm_requests": 48, "ledger_off_tokens_per_s": 661.0,
+        "ledger_on_tokens_per_s": 657.0, "ledger_overhead_ratio": 0.994,
+        "ledger_stage_queue_p50_s": 0.0021, "ledger_stage_queue_p99_s": 0.011,
+        "ledger_stage_queue_share": 0.31,
+        "ledger_stage_prefill_p50_s": 0.0009,
+        "ledger_stage_prefill_p99_s": 0.0041,
+        "ledger_stage_prefill_share": 0.12,
+        "ledger_stage_decode_p50_s": 0.0034,
+        "ledger_stage_decode_p99_s": 0.0089,
+        "ledger_stage_decode_share": 0.55,
+        "ledger_stage_guardrail_p50_s": 0.0,
+        "ledger_stage_guardrail_p99_s": 0.0,
+        "ledger_stage_guardrail_share": 0.02,
+        "ledger_p99_blame_queue": 0.44, "ledger_p99_blame_prefill": 0.08,
+        "ledger_p99_blame_decode": 0.46, "ledger_p99_blame_guardrail": 0.02,
+        "ledger_e2e_p99_s": 0.021, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "guardrails": {
         "storm_requests": 48, "bring_up_cold_s": 4.2,
         "guardrails_breaker_trips": 1, "guardrails_hedged": 0,
@@ -186,6 +204,8 @@ def test_healthy_branch_headline_and_detail(bench):
     assert headline["prefix_tokens_per_s_improvement"] == 1.839
     assert headline["prefix_p95_ttft_improvement"] == 1.848
     assert full["serving_prefix"]["prefix_hits"] == 38
+    assert headline["ledger_overhead_ratio"] == 0.994
+    assert full["serving_ledger"]["ledger_p99_blame_queue"] == 0.44
     assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208

@@ -68,14 +68,24 @@ TEMPLATES: Dict[str, Tuple[str, ...]] = {
     "tdx.pp.segment_{s.role}_ms": tuple(
         f"tdx.pp.segment_{r}_ms" for r in ("warmup", "steady", "cooldown")
     ),
+    # Request-ledger stage attribution (observe/reqledger.py STAGES).
+    "tdx.serve.stage_{st}_s": tuple(
+        f"tdx.serve.stage_{st}_s"
+        for st in ("queue", "prefill", "decode", "guardrail")
+    ),
 }
 
 
 def emitted_metrics() -> Dict[str, List[str]]:
-    """{concrete metric name: [files emitting it]} across the package
-    and bench.py, with f-string templates expanded via TEMPLATES."""
+    """{concrete metric name: [files emitting it]} across the package,
+    bench.py, and tools/, with f-string templates expanded via
+    TEMPLATES.  EVERY emission site anywhere in the repo is in scope —
+    a new emitter outside these globs should extend them, not dodge the
+    lint."""
     files = sorted(glob.glob(
         os.path.join(REPO, "torchdistx_tpu", "**", "*.py"), recursive=True,
+    )) + sorted(glob.glob(
+        os.path.join(REPO, "tools", "*.py"),
     )) + [os.path.join(REPO, "bench.py")]
     out: Dict[str, List[str]] = {}
     for fn in files:

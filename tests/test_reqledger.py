@@ -1,0 +1,537 @@
+"""Per-request attribution ledger (ISSUE 17 tentpole): every request's
+end-to-end latency decomposes into queue / prefill / decode / guardrail
+time that sums to e2e BY CONSTRUCTION — through aborts, retries and
+hedging — with one flow id joining its fleet-side instants across
+replicas, bounded per-request memory under the event cap, a working
+``TDX_REQUEST_LEDGER=0`` kill switch, live ``/requests`` + ``/tail``
+endpoints, ledger state folded into flight dumps, and
+``tdx_trace.py autopsy`` reconstructing a hedged + chaos-killed +
+requeued request as one coherent timeline from the flushed trace."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchdistx_tpu.config as tdx_config
+from torchdistx_tpu import chaos, observe
+from torchdistx_tpu.models import TransformerConfig
+from torchdistx_tpu.observe import httpd, reqledger
+from torchdistx_tpu.serve import (
+    FleetConfig,
+    GuardrailConfig,
+    Request,
+    ServeConfig,
+    ServeFleet,
+    oracle_generate,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "tdx_trace.py")
+
+LLAMA = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32,
+)
+SCFG = ServeConfig(max_batch=2, page_size=8, n_pages=16,
+                   max_pages_per_seq=3, prefill_buckets=(8, 16))
+
+
+@pytest.fixture(scope="module")
+def shared_cache(tmp_path_factory):
+    """One persistent compile cache for the fleet test in this module
+    (same contract as tests/test_fleet.py's fixture)."""
+    d = str(tmp_path_factory.mktemp("ledger_cache"))
+    old = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ["TDX_CACHE_MIN_COMPILE_S"] = "0"
+    yield d
+    if old is None:
+        os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+    else:
+        os.environ["TDX_CACHE_MIN_COMPILE_S"] = old
+
+
+@pytest.fixture()
+def ledger():
+    """Telemetry on, ledger empty; everything torn down afterwards."""
+    observe.enable(True)
+    observe.reset()
+    yield
+    observe.enable(None)
+    observe.reset()
+
+
+def _stage_sum(summ: dict) -> float:
+    return sum(summ[f"{st}_s"] for st in reqledger.STAGES)
+
+
+def _kinds(detail: dict):
+    return [e["k"] for e in detail["events"]]
+
+
+# ---------------------------------------------------------------------------
+# the stage machine: attribution sums to e2e by construction
+# ---------------------------------------------------------------------------
+
+
+def test_attribution_sums_through_abort_and_retry(ledger):
+    """An aborted attempt's prefill+decode folds into guardrail time and
+    the stage machine returns to queue — the four stages still sum to
+    the end-to-end latency, and the retry counts as a second attempt."""
+    rid = "att-1"
+    reqledger.on_enqueue(rid, priority=0, n_prompt=4)
+    time.sleep(0.004)                      # queue
+    reqledger.on_admit(rid, replica="serve-r1", prefix_tokens=2)
+    time.sleep(0.004)                      # attempt 1 prefill
+    reqledger.on_decode(rid, n_lanes=2, replica="serve-r1")
+    time.sleep(0.004)                      # attempt 1 decode
+    reqledger.on_abort(rid, replica="serve-r1", reason="replica_dead")
+    time.sleep(0.004)                      # re-queued
+    reqledger.on_admit(rid, replica="serve-r2")
+    time.sleep(0.002)
+    reqledger.on_decode(rid, n_lanes=1, replica="serve-r2")
+    reqledger.on_finish(rid, replica="serve-r2", tokens=5)
+
+    summ = reqledger.summary(rid)
+    assert summ is not None and summ["outcome"] == "ok"
+    assert summ["attempts"] == 2
+    assert summ["prefix_tokens"] == 2
+    assert summ["guardrail_s"] > 0.0       # the dead attempt's spent work
+    assert summ["queue_s"] > 0.0           # initial wait + requeue gap
+    assert abs(_stage_sum(summ) - summ["e2e_s"]) < 1e-4, summ
+    ks = _kinds(summ)
+    assert ks[0] == "enqueue" and ks[-1] == "finish"
+    assert "abort" in ks and ks.count("admit") == 2
+
+
+def test_hedge_loser_abort_is_an_event_not_a_stage_change(ledger):
+    """While the hedge winner is still running, the loser's cancel must
+    not reopen the queue stage or fold an attempt — it is timeline
+    evidence only.  The winner's time lands in prefill/decode and
+    guardrail stays zero."""
+    rid = "hedge-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_event(rid, "hedge", primary=1, mate=2)
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_admit(rid, replica="serve-r2")   # the hedge mate admits too
+    reqledger.on_decode(rid, n_lanes=1, replica="serve-r1")
+    reqledger.on_event(rid, "hedge_win", replica=1)
+    reqledger.on_abort(rid, replica="serve-r2", reason="hedge_lost")
+    time.sleep(0.002)
+    reqledger.on_decode(rid, n_lanes=1, replica="serve-r1")
+    reqledger.on_finish(rid, replica="serve-r1", tokens=2)
+
+    summ = reqledger.summary(rid)
+    assert summ["hedged"] is True
+    assert summ["attempts"] == 1          # one externally-visible attempt
+    assert summ["guardrail_s"] == 0.0     # loser cancelled while winner ran
+    assert summ["decode_s"] > 0.0
+    assert abs(_stage_sum(summ) - summ["e2e_s"]) < 1e-4, summ
+
+
+def test_decode_ticks_coalesce_into_one_event(ledger):
+    """A long generation is one timeline slot, not one per token; an
+    interleaved event (a COW copy) opens a fresh coalesced stretch."""
+    rid = "dc-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    for _ in range(50):
+        reqledger.on_decode(rid, n_lanes=2, replica="serve-r1")
+    reqledger.on_cow(rid, replica="serve-r1")
+    for _ in range(3):
+        reqledger.on_decode(rid, n_lanes=2, replica="serve-r1")
+    reqledger.on_finish(rid, tokens=53)
+
+    detail = reqledger.summary(rid)
+    assert _kinds(detail) == ["enqueue", "admit", "decode", "cow",
+                              "decode", "finish"]
+    first, second = [e for e in detail["events"] if e["k"] == "decode"]
+    assert first["ticks"] == 50 and first["toks"] == 50
+    assert second["ticks"] == 3
+    assert detail["tokens"] == 53
+    assert detail["cow_copies"] == 1
+
+
+def test_event_timeline_bounded_with_drop_count(ledger):
+    """``TDX_LEDGER_EVENTS`` caps per-request memory: overflow evicts
+    the oldest events and counts them, never grows without bound."""
+    with tdx_config.override(ledger_events=8):
+        rid = "cap-1"
+        reqledger.on_enqueue(rid)
+        reqledger.on_admit(rid, replica="serve-r1")
+        for _ in range(20):
+            reqledger.on_cow(rid, replica="serve-r1")
+        reqledger.on_finish(rid, tokens=1)
+        detail = reqledger.summary(rid)
+    assert len(detail["events"]) == 8
+    assert detail["events_dropped"] == 15   # 22 appends - 8 kept + terminal
+    assert detail["events"][-1]["k"] == "finish"   # terminal never dropped
+
+
+def test_kill_switch_records_nothing(ledger):
+    """``TDX_REQUEST_LEDGER=0``: every hook degrades to one enabled
+    check; no records, no flow ids, no finished count."""
+    with tdx_config.override(request_ledger=False):
+        assert not reqledger.enabled()
+        reqledger.on_enqueue("ks-1", priority=0)
+        reqledger.on_admit("ks-1", replica="serve-r1")
+        reqledger.on_decode("ks-1", n_lanes=1)
+        reqledger.on_finish("ks-1", tokens=1)
+        reqledger.occupancy_sample(decode_busy=1, decode_lanes=2)
+    assert reqledger.summary("ks-1") is None
+    assert reqledger.flow_id("ks-1") is None
+    rep = reqledger.requests_report()
+    assert rep["finished"] == 0 and not rep["live"] and not rep["recent"]
+    assert reqledger.occupancy_report()["count"] == 0
+    assert reqledger.enabled()   # back on outside the override
+
+
+def test_finalize_is_idempotent_and_door_rejects_record(ledger):
+    """Racing terminal paths (engine deadline + fleet reject) finalize
+    once; a reject with no prior record (brownout at the door) still
+    lands a typed zero-duration terminal in the tail window."""
+    rid = "fin-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_finish(rid, tokens=1)
+    reqledger.on_finish(rid, tokens=1)                  # duplicate
+    reqledger.on_reject(rid, reason="deadline")         # racing path
+    assert reqledger.requests_report()["finished"] == 1
+
+    reqledger.on_reject("door-1", reason="queue_full")
+    rep = reqledger.requests_report()
+    assert rep["finished"] == 2
+    tail = reqledger.tail_report()
+    assert tail["outcomes"].get("queue_full") == 1
+    assert tail["outcomes"].get("ok") == 1
+
+
+def test_flow_id_minted_once_and_survives_finish(ledger):
+    """The flow id is the request's cross-replica join key: minted at
+    enqueue, stable through finish, paired start/finish flow events in
+    the tracer, and stamped on the terminal ``serve.request`` instant
+    along with the full attribution detail."""
+    rid = "flow-1"
+    reqledger.on_enqueue(rid)
+    flow = reqledger.flow_id(rid)
+    assert flow is not None
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_decode(rid, n_lanes=1)
+    reqledger.on_finish(rid, tokens=1)
+    assert reqledger.flow_id(rid) == flow   # recent ring still answers
+
+    events = observe.tracer().drain()
+    starts = [e for e in events if e.get("ph") == "s"
+              and e.get("id") == flow]
+    finishes = [e for e in events if e.get("ph") == "f"
+                and e.get("id") == flow]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["name"] == "tdx.serve.request"
+    term = [e for e in events if e.get("ph") == "i"
+            and e.get("name") == "serve.request"
+            and (e.get("args") or {}).get("rid") == rid]
+    assert len(term) == 1
+    args = term[0]["args"]
+    assert args["flow"] == flow and args["outcome"] == "ok"
+    assert [ev["k"] for ev in args["events"]][-1] == "finish"
+
+
+def test_stage_histograms_emitted_on_finish(ledger):
+    rid = "hist-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_decode(rid, n_lanes=1)
+    reqledger.on_finish(rid, tokens=1)
+    names = {r["name"] for r in observe.counters().snapshot()}
+    for st in reqledger.STAGES:
+        assert f"tdx.serve.stage_{st}_s" in names
+    assert "tdx.serve.request_e2e_s" in names
+
+
+# ---------------------------------------------------------------------------
+# aggregation: tail report, occupancy ring, flight dumps
+# ---------------------------------------------------------------------------
+
+
+def test_tail_report_percentiles_and_p99_blame(ledger):
+    """The fleet rollup: e2e percentiles, per-stage shares, and a p99
+    blame breakdown whose shares sum to ~1 for the slow cohort."""
+    for i in range(10):
+        rid = f"tail-{i}"
+        reqledger.on_enqueue(rid)
+        if i == 9:
+            time.sleep(0.01)   # one deliberately queue-bound straggler
+        reqledger.on_admit(rid, replica="serve-r1")
+        reqledger.on_decode(rid, n_lanes=1)
+        reqledger.on_finish(rid, tokens=1)
+    tail = reqledger.tail_report()
+    assert tail["completed"] == 10
+    assert tail["e2e_s"]["p99"] >= tail["e2e_s"]["p50"] > 0.0
+    assert set(tail["stages"]) == set(reqledger.STAGES)
+    blame = tail["p99_blame"]
+    assert abs(sum(blame.values()) - 1.0) < 0.01
+    # the straggler IS the p99 sample, and it waited in queue
+    assert blame["queue"] > 0.5, blame
+
+
+def test_occupancy_ring_and_gauge(ledger):
+    reqledger.occupancy_sample(replica="serve-r1", decode_busy=1,
+                               decode_lanes=2, kv_pages_free=7,
+                               kv_pages_shared=3, prefix_hit_rate=0.25,
+                               queue_depth=4)
+    rep = reqledger.occupancy_report()
+    assert rep["count"] == 1
+    s = rep["samples"][0]
+    assert (s["busy"], s["lanes"], s["free"], s["shared"], s["depth"]) \
+        == (1, 2, 7, 3, 4)
+    assert s["hit_rate"] == 0.25
+    gauges = {r["name"]: r["value"]
+              for r in observe.counters().snapshot() if r["type"] == "gauge"}
+    assert gauges["tdx.serve.decode_occupancy"] == 0.5
+
+
+def test_flight_dump_carries_ledger_snapshot(ledger, tmp_path):
+    """A post-mortem bundle answers "who was in flight and where had
+    their time gone": the dump gains a top-level ``ledger`` key with the
+    tail report, live summaries, and occupancy samples."""
+    reqledger.on_enqueue("fd-done")
+    reqledger.on_admit("fd-done", replica="serve-r1")
+    reqledger.on_finish("fd-done", tokens=1)
+    reqledger.on_enqueue("fd-live")           # still in flight at dump time
+    reqledger.occupancy_sample(decode_busy=1, decode_lanes=2)
+    with tdx_config.override(flight_dir=str(tmp_path)):
+        path = observe.flight_dump("ledger_test")
+    assert path is not None
+    doc = json.load(open(path))
+    led = doc["ledger"]
+    assert set(led) == {"tail", "live", "occupancy"}
+    assert led["tail"]["finished"] == 1
+    assert [r["rid"] for r in led["live"]] == ["fd-live"]
+    assert len(led["occupancy"]) == 1
+
+
+def test_reset_drops_everything(ledger):
+    reqledger.on_enqueue("rst-1")
+    reqledger.on_finish("rst-1")
+    reqledger.occupancy_sample(decode_busy=1, decode_lanes=1)
+    reqledger.reset()
+    rep = reqledger.requests_report()
+    assert rep["finished"] == 0 and not rep["recent"]
+    assert reqledger.occupancy_report()["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the HTTP plane: /requests and /tail
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_requests_and_tail_endpoints(tmp_path):
+    observe.stop_background()
+    observe.reset()
+    observe.enable(True)
+    try:
+        port_file = tmp_path / "obs.port"
+        with tdx_config.override(obs_port=0, obs_port_file=str(port_file)):
+            observe.counter("tdx.test.reqledger_http").inc()  # arm
+            server = httpd.get_server()
+            assert server is not None and server.is_alive()
+
+            rid = "http-1"
+            reqledger.on_enqueue(rid, priority=0, n_prompt=4)
+            reqledger.on_admit(rid, replica="serve-r1", prefix_tokens=2)
+            reqledger.on_decode(rid, n_lanes=2, replica="serve-r1")
+            reqledger.on_finish(rid, tokens=3)
+
+            status, body = _get(server.url("/"))
+            assert status == 200
+            idx = json.loads(body)["endpoints"]
+            assert "/requests" in idx and "/tail" in idx
+
+            status, body = _get(server.url("/requests"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["finished"] == 1
+            assert doc["recent"][0]["rid"] == rid
+
+            status, body = _get(server.url(f"/requests/{rid}"))
+            assert status == 200
+            detail = json.loads(body)
+            assert detail["outcome"] == "ok"
+            assert abs(_stage_sum(detail) - detail["e2e_s"]) < 1e-4
+            assert _kinds(detail)[0] == "enqueue"
+
+            assert _get(server.url("/requests/nope"))[0] == 404
+
+            status, body = _get(server.url("/tail"))
+            assert status == 200
+            tail = json.loads(body)
+            assert tail["completed"] == 1
+            assert set(tail["p99_blame"]) == set(reqledger.STAGES)
+    finally:
+        observe.enable(None)
+        observe.stop_background()
+        observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: hedged + chaos-killed + requeued, one flow, one
+# coherent autopsy timeline (satellite: flow propagation tests)
+# ---------------------------------------------------------------------------
+
+
+def _check_oracle(fl, reqs, out):
+    for r in reqs:
+        want, _ = oracle_generate(
+            fl.family, fl.cfg, fl.params, r.tokens, r.max_new_tokens,
+            r.eos_id,
+        )
+        assert out[r.rid] == want, (r.rid, out[r.rid], want)
+
+
+@pytest.mark.slow
+def test_fleet_storm_hedge_kill_requeue_one_flow_and_autopsy(
+        shared_cache, tmp_path):
+    """A 2-replica storm with zero-threshold hedging and a chaos
+    replica-kill: every finished request's stages sum to e2e, hedge and
+    requeue instants across replicas share the request's ONE flow id,
+    and ``tdx_trace.py autopsy`` reconstructs a hedged request as a
+    single coherent timeline from the flushed trace."""
+    gc = GuardrailConfig(breaker=False, brownout=False,
+                         hedging=True, hedge_wait_frac=0.0)
+    trace_dir = tmp_path / "traces"
+    observe.enable(True)
+    observe.reset()
+    try:
+        with tdx_config.override(cache_dir=shared_cache,
+                                 trace_dir=str(trace_dir)):
+            fl = ServeFleet(
+                LLAMA, family="llama", serve_cfg=SCFG,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=2,
+                                      autoscale=False, stall_s=60.0,
+                                      guardrails=gc),
+            )
+            with fl:
+                fl.start(2, timeout=240.0)
+                chaos.install("fleet@2=raise")
+                try:
+                    reqs = [
+                        Request(f"lg{i}", [(5 * i + j) % 128
+                                           for j in range(2 + i % 4)],
+                                max_new_tokens=4 + (i % 3),
+                                deadline_s=120.0, arrival_step=i)
+                        for i in range(10)
+                    ]
+                    i = 0
+                    deadline = time.monotonic() + 240.0
+                    while i < len(reqs) or fl._pending:
+                        while (i < len(reqs)
+                               and reqs[i].arrival_step <= fl._tick_no):
+                            fl.submit(reqs[i])
+                            i += 1
+                        fl.tick()
+                        assert time.monotonic() < deadline, (
+                            fl._pending, [h.state for h in fl.handles])
+                        time.sleep(0.0005)
+                finally:
+                    chaos.clear()
+                out = dict(fl.results)
+                assert set(out) == {r.rid for r in reqs}
+                assert not fl.rejected
+                _check_oracle(fl, reqs, out)
+
+                # every request attributed, stages sum to e2e
+                hedged, retried, flows = [], [], {}
+                for r in reqs:
+                    summ = reqledger.summary(r.rid)
+                    assert summ is not None and summ["outcome"] == "ok", r.rid
+                    assert abs(_stage_sum(summ) - summ["e2e_s"]) < 5e-3, summ
+                    flows[r.rid] = summ["flow"]
+                    assert flows[r.rid] is not None
+                    if summ["hedged"]:
+                        hedged.append(r.rid)
+                    if summ["attempts"] > 1:
+                        retried.append(r.rid)
+                assert hedged, "zero-threshold hedging never fired"
+                assert retried, "the chaos kill requeued nothing"
+                # flow ids are per-request unique (the join key is real)
+                assert len(set(flows.values())) == len(flows)
+        observe.flush(trace_dir=str(trace_dir))
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+    # -- the flushed trace joins the story back together ------------------
+    files = glob.glob(str(trace_dir / "*.json"))
+    assert files
+    events = []
+    for fn in files:
+        events.extend(json.load(open(fn))["traceEvents"])
+
+    def instants(name, flow):
+        return [e for e in events if e.get("ph") == "i"
+                and e.get("name") == name
+                and (e.get("args") or {}).get("flow") == flow]
+
+    rid_h = hedged[0]
+    assert instants("fleet.hedge", flows[rid_h]), \
+        "fleet.hedge instant does not carry the request's flow id"
+    for rid in retried:
+        assert instants("fleet.requeue", flows[rid]), \
+            f"fleet.requeue for {rid} does not carry its flow id"
+    # terminal instants: one per request, flow-stamped, timeline aboard
+    for r in reqs:
+        term = [e for e in events if e.get("ph") == "i"
+                and e.get("name") == "serve.request"
+                and (e.get("args") or {}).get("rid") == r.rid]
+        assert len(term) == 1, r.rid
+        assert term[0]["args"]["flow"] == flows[r.rid]
+
+    # a requeued request's timeline shows both replicas
+    if set(hedged) & set(retried):
+        rid_hr = sorted(set(hedged) & set(retried))[0]
+        detail = next(e["args"] for e in events
+                      if e.get("ph") == "i"
+                      and e.get("name") == "serve.request"
+                      and (e.get("args") or {}).get("rid") == rid_hr)
+        admits = {ev.get("replica") for ev in detail["events"]
+                  if ev["k"] == "admit"}
+        assert len(admits) >= 2, detail["events"]
+
+    # -- autopsy: one coherent reconstructed life --------------------------
+    proc = subprocess.run(
+        [sys.executable, CLI, "autopsy", rid_h, str(trace_dir)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = proc.stdout
+    assert f"== autopsy: rid={rid_h}" in rep
+    assert "attribution (stages sum to e2e by construction):" in rep
+    for st in reqledger.STAGES:
+        assert st in rep
+    assert "timeline (" in rep
+    assert "hedge" in rep
+
+    proc = subprocess.run(
+        [sys.executable, CLI, "autopsy", "no-such-rid", str(trace_dir)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2

@@ -80,6 +80,13 @@ GATES: List[Tuple[str, str, float]] = [
     # same loose floor.
     (r"^prefix_tokens_per_s_improvement$", "up", 0.50),
     (r"^prefix_p95_ttft_improvement$", "up", 0.50),
+    # Request-ledger overhead (bench.py serving_ledger phase, r17 on):
+    # tokens/s with the per-request ledger on / off, same storm.  The
+    # phase gates >= 0.98 absolutely (the <=2% overhead claim); the
+    # trend gate catches the ratio quietly sliding across rounds.  The
+    # ratio hugs 1.0 by construction, so it gets a tight floor — and
+    # must stay ABOVE the generic _speedup entry (first match wins).
+    (r"^ledger_overhead_ratio$", "up", 0.10),
     (r"_speedup$", "up", 0.15),
     (r"_mfu$", "up", 0.15),
     (r"_rss_mb$", "down", 0.15),

@@ -33,6 +33,18 @@ Commands:
     whose subdirectories hold the telemetry expands to one host per
     subdir (the natural layout for ``TDX_FLIGHT_DIR=/logs/%h``).
 
+``autopsy <request-id> <dir-or-file>...``
+    Reconstruct ONE request's life across the whole serve fleet from
+    merged telemetry (trace files + flight-dump rings): its ledger
+    timeline (enqueue → dispatch → admit/chunk/decode → hedge /
+    preempt / requeue hops → finish or typed rejection) interleaved
+    with the fleet-side instants carrying the same rid/flow id, plus
+    the queue/prefill/decode/guardrail attribution that sums to the
+    end-to-end latency by construction.  The terminal ``serve.request``
+    instant (emitted by ``observe.reqledger``) is the primary source; a
+    request still in flight at crash time is recovered from a flight
+    dump's ``ledger.live`` table.
+
 Exit status: 0 on success, 2 when no telemetry was found.
 """
 
@@ -477,6 +489,155 @@ def render_flight(path: str, doc: dict, top: int = 8) -> str:
     return "\n".join(lines)
 
 
+# -- per-request autopsy -----------------------------------------------------
+
+# The ledger's stage vocabulary (observe/reqledger.py STAGES); the
+# attribution contract is that these sum to the end-to-end latency.
+AUTOPSY_STAGES = ("queue", "prefill", "decode", "guardrail")
+
+
+def _merge_event_sources(events: List[dict],
+                         flight_docs: List[dict]) -> List[dict]:
+    """Trace-file events plus every flight dump's ring, deduplicated:
+    the recorder TEES the tracer, so an event that was both flushed and
+    dumped appears in both sources with identical fields."""
+    seen: set = set()
+    out: List[dict] = []
+    for e in events + [e for doc in flight_docs
+                       for e in doc.get("events", [])
+                       if isinstance(e, dict)]:
+        key = (e.get("ts"), e.get("ph"), e.get("name"), e.get("pid"),
+               e.get("tid"), json.dumps(e.get("args"), sort_keys=True,
+                                        default=str))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
+
+
+def _autopsy_detail(events: List[dict],
+                    flight_docs: List[dict],
+                    rid: str) -> Tuple[Optional[dict], Optional[float]]:
+    """The request's ledger detail and (when known) the trace timestamp
+    of its terminal instant.  Finished requests ride the ``serve.request``
+    instant (args = full detail, events included); a request that was
+    still live when a flight dump fired falls back to the dump's
+    ``ledger.live`` summary (no timeline, but stage attribution)."""
+    best: Optional[Tuple[float, dict]] = None
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "serve.request":
+            continue
+        a = e.get("args") or {}
+        if a.get("rid") != rid:
+            continue
+        ts = float(e.get("ts", 0.0))
+        if best is None or ts >= best[0]:
+            best = (ts, a)
+    if best is not None:
+        return dict(best[1]), best[0]
+    for doc in flight_docs:
+        for entry in (doc.get("ledger") or {}).get("live", []):
+            if isinstance(entry, dict) and entry.get("rid") == rid:
+                return dict(entry), None
+    return None, None
+
+
+def _fmt_attrs(attrs: dict, drop=("rid", "flow")) -> str:
+    parts = [f"{k}={v}" for k, v in attrs.items()
+             if k not in drop and v is not None]
+    return "  ".join(parts)
+
+
+def autopsy_report(events: List[dict], flight_docs: List[dict],
+                   rid: str) -> Optional[str]:
+    """One request's reconstructed life, or None when the telemetry
+    never saw it."""
+    detail, end_ts = _autopsy_detail(events, flight_docs, rid)
+    flow = detail.get("flow") if detail else None
+    related = []
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") == "serve.request":
+            continue
+        a = e.get("args") or {}
+        if a.get("rid") == rid or (flow is not None and a.get("flow") == flow):
+            related.append(e)
+    if detail is None and not related:
+        return None
+
+    lines = [f"== autopsy: rid={rid}"
+             + (f"   flow=0x{flow:x}" if isinstance(flow, int) else "")]
+    if detail is None:
+        lines.append("  no ledger record (TDX_REQUEST_LEDGER=0, or the "
+                     "terminal event left the ring); fleet instants only:")
+        for e in sorted(related, key=lambda e: float(e.get("ts", 0.0))):
+            lines.append(f"    {e.get('name', '?'):<20} "
+                         f"{_fmt_attrs(e.get('args') or {})}")
+        return "\n".join(lines)
+
+    outcome = detail.get("outcome")
+    head = [f"outcome={outcome if outcome else 'IN FLIGHT (' + str(detail.get('stage')) + ')'}",
+            f"attempts={detail.get('attempts', 1)}"]
+    if detail.get("hedged"):
+        head.append("hedged")
+    head.append(f"tokens={detail.get('tokens', 0)}")
+    if detail.get("n_prompt") is not None:
+        head.append(f"prompt={detail['n_prompt']}")
+    if detail.get("prefix_tokens"):
+        head.append(f"prefix_hit={detail['prefix_tokens']}")
+    if detail.get("cow_copies"):
+        head.append(f"cow={detail['cow_copies']}")
+    lines.append("  " + "  ".join(head))
+
+    e2e = detail.get("e2e_s")
+    stage_sum = sum(float(detail.get(f"{st}_s", 0.0))
+                    for st in AUTOPSY_STAGES)
+    lines.append("  attribution (stages sum to e2e by construction):")
+    denom = e2e if e2e else stage_sum
+    for st in AUTOPSY_STAGES:
+        v = float(detail.get(f"{st}_s", 0.0))
+        pct = f"  ({v / denom:.1%})" if denom else ""
+        lines.append(f"    {st:<10} {v:>11.6f}s{pct}")
+    if e2e is not None:
+        lines.append(
+            f"    {'e2e':<10} {float(e2e):>11.6f}s  "
+            f"(stages sum {stage_sum:.6f}s, "
+            f"residual {abs(float(e2e) - stage_sum):.6f}s)"
+        )
+
+    # One merged timeline: ledger events are relative to the request's
+    # t0 already; fleet/replica instants are re-anchored onto the same
+    # clock via the terminal instant (its ts marks t0 + e2e).
+    rows: List[Tuple[float, str, str]] = []
+    for ev in detail.get("events", []) or []:
+        attrs = {k: v for k, v in ev.items() if k not in ("t", "k")}
+        rows.append((float(ev.get("t", 0.0)), ev.get("k", "?"),
+                     _fmt_attrs(attrs)))
+    t0_us = (end_ts - float(e2e) * 1e6
+             if end_ts is not None and e2e is not None else None)
+    unanchored = 0
+    for e in sorted(related, key=lambda e: float(e.get("ts", 0.0))):
+        label = e.get("name", "?")
+        attrs = _fmt_attrs(e.get("args") or {})
+        if t0_us is not None:
+            rows.append(((float(e.get("ts", 0.0)) - t0_us) / 1e6,
+                         label, attrs))
+        else:
+            unanchored += 1
+            lines.append(f"    [unanchored] {label:<18} {attrs}")
+    rows.sort(key=lambda r: r[0])
+    if rows:
+        lines.append(f"  timeline ({len(rows)} events"
+                     + (f", {unanchored} unanchored" if unanchored else "")
+                     + "):")
+        for t, kind, attrs in rows:
+            lines.append(f"    {t:>+11.6f}s  {kind:<18} {attrs}")
+    if detail.get("events_dropped"):
+        lines.append(f"  ({detail['events_dropped']} ledger event(s) "
+                     f"dropped at the per-request cap)")
+    return "\n".join(lines)
+
+
 # -- fleet rollup ------------------------------------------------------------
 
 # Gauges where max-over-processes is the honest rollup: percentiles,
@@ -807,7 +968,32 @@ def main(argv=None) -> int:
     pl.add_argument("paths", nargs="+")
     pl.add_argument("--top", type=int, default=3,
                     help="slowest spans per host")
+    pa = sub.add_parser(
+        "autopsy", help="reconstruct one request's life across the fleet")
+    pa.add_argument("rid", help="the request id to reconstruct")
+    pa.add_argument("paths", nargs="+")
     args = ap.parse_args(argv)
+
+    if args.cmd == "autopsy":
+        events = load_events(args.paths)
+        docs: List[dict] = []
+        for path in find_flight_dumps(args.paths):
+            try:
+                with open(path) as f:
+                    docs.append(json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"warning: skipping {path}: {e}", file=sys.stderr)
+        if not events and not docs:
+            print("no telemetry found", file=sys.stderr)
+            return 2
+        text = autopsy_report(
+            _merge_event_sources(events, docs), docs, args.rid)
+        if text is None:
+            print(f"request {args.rid!r} not found in telemetry",
+                  file=sys.stderr)
+            return 2
+        print(text)
+        return 0
 
     if args.cmd == "flight":
         dump_paths = find_flight_dumps(args.paths)

@@ -158,6 +158,19 @@ Environment variables (read at first import):
                         scheduling knob: the compiled program set is
                         identical at every setting (see docs/serving.md
                         §Prefix sharing & chunked prefill).
+``TDX_REQUEST_LEDGER``  "0" disables the per-request attribution ledger
+                        (:mod:`torchdistx_tpu.observe.reqledger`): the
+                        serve stack's per-request typed event timeline,
+                        queue/prefill/decode/guardrail latency
+                        attribution, tail aggregator (``/requests`` and
+                        ``/tail``), and occupancy time-series.  On by
+                        default — the ledger is bounded-memory and
+                        samples only on events the stack already emits
+                        (see docs/observability.md §Request ledger).
+``TDX_LEDGER_EVENTS``   Per-request event-timeline cap (default 128):
+                        older events are dropped (and counted) once a
+                        request's timeline is full, so a pathological
+                        request cannot grow ledger memory without bound.
 ``TDX_TRACE_PARENT``    Causal trace-context handoff (NOT a Config field —
                         read once by :mod:`torchdistx_tpu.observe.tracectx`
                         at adoption): a parent process that spawns work
@@ -211,6 +224,8 @@ class Config:
     materialize_batch_put: bool = True
     reshard_chunk_mb: float = 64.0
     prefill_chunk: int = 0
+    request_ledger: bool = True
+    ledger_events: int = 128
 
 
 def _from_env() -> Config:
@@ -251,6 +266,8 @@ def _from_env() -> Config:
         ),
         reshard_chunk_mb=float(os.environ.get("TDX_RESHARD_CHUNK_MB", "64")),
         prefill_chunk=int(os.environ.get("TDX_PREFILL_CHUNK", "0")),
+        request_ledger=os.environ.get("TDX_REQUEST_LEDGER", "1") != "0",
+        ledger_events=int(os.environ.get("TDX_LEDGER_EVENTS", "128")),
     )
 
 

@@ -66,7 +66,7 @@ import os
 import threading
 from typing import Optional
 
-from . import costmodel, flightrec, health, httpd, slo, tracectx
+from . import costmodel, flightrec, health, httpd, reqledger, slo, tracectx
 from .metrics import Counter, Counters, Gauge, Histogram, JsonlSink
 from .spans import Span, Tracer, _NOOP_SPAN, set_drop_hook, set_flight_feed
 from .step import StepMeter, peak_tflops_for
@@ -95,6 +95,7 @@ __all__ = [
     "httpd",
     "instant",
     "peak_tflops_for",
+    "reqledger",
     "reset",
     "slo",
     "span",
@@ -264,6 +265,7 @@ def reset() -> None:
     _TRACER.clear()
     _COUNTERS.clear()
     flightrec.clear()
+    reqledger.reset()
     _last_counters_sig = None
 
 

@@ -219,6 +219,16 @@ def dump(reason: str, **context) -> Optional[str]:
         from .tracectx import trace_context
 
         ctx = trace_context()
+        try:
+            # Extra (non-schema) key: the request ledger's post-mortem
+            # view — tail attribution, in-flight requests, occupancy.
+            # validate() only flags MISSING required keys, so v1/v2
+            # readers are unaffected.
+            from . import reqledger
+
+            ledger: Optional[dict] = reqledger.flight_snapshot()
+        except Exception:
+            ledger = None
         doc = {
             "schema": SCHEMA_VERSION,
             "reason": reason,
@@ -234,6 +244,8 @@ def dump(reason: str, **context) -> Optional[str]:
             "counter_snapshots": _counter_snapshots(),
             "context": _jsonable(context),
         }
+        if ledger is not None:
+            doc["ledger"] = ledger
         os.makedirs(fdir, exist_ok=True)
         path = os.path.join(
             fdir, f"flight-{os.getpid()}-{seq:03d}-{_safe(reason)}.json"

@@ -28,6 +28,14 @@ scrapeable LIVE from the running process, with the same rendering code
     Flight-recorder dumps: the index lists ``TDX_FLIGHT_DIR``'s bundles
     (name/reason/time/size), ``/flight/<name>`` fetches one verbatim —
     reading a post-mortem during the incident instead of after it.
+``/requests``
+    The per-request attribution ledger (:mod:`.reqledger`): live
+    in-flight summaries plus the recent-completions ring with full
+    event timelines; ``/requests/<rid>`` fetches one request's detail.
+``/tail``
+    Fleet-wide tail attribution over the finished-request window:
+    per-stage latency percentiles, mean stage shares, and the "p99
+    blame" breakdown (where the slowest 5% actually spent their time).
 
 Lifecycle mirrors the PR 8 periodic exporter: opt-in via
 ``TDX_OBS_PORT`` (port 0 = ephemeral, the bound port is written to
@@ -93,6 +101,7 @@ class _Handler(BaseHTTPRequestHandler):
         if path in ("/", "/index"):
             return self._json(200, {"endpoints": [
                 "/metrics", "/healthz", "/readyz", "/slo", "/flight",
+                "/requests", "/tail",
             ]})
         if path == "/metrics":
             from . import counters
@@ -114,6 +123,21 @@ class _Handler(BaseHTTPRequestHandler):
             from . import slo
 
             return self._json(200, {"slo": slo.snapshot_all()})
+        if path == "/requests":
+            from . import reqledger
+
+            return self._json(200, reqledger.requests_report())
+        if path.startswith("/requests/"):
+            from . import reqledger
+
+            detail = reqledger.summary(path[len("/requests/"):])
+            if detail is None:
+                return (404, "text/plain; charset=utf-8", b"not found\n")
+            return self._json(200, detail)
+        if path == "/tail":
+            from . import reqledger
+
+            return self._json(200, reqledger.tail_report())
         if path == "/flight":
             return self._json(200, {"dumps": _flight_index()})
         if path.startswith("/flight/"):

@@ -79,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import chaos, observe
+from ..observe import reqledger
 from ..models import PRESETS, TransformerConfig
 from ..utils.logging import get_logger
 from .kv_cache import OutOfPages, PagedKVCache, init_pools
@@ -288,6 +289,12 @@ class ServeEngine:
         # time (mirrors the _submit_t queue-wait contract).
         if req.deadline_s is not None and not hasattr(req, "_deadline_t"):
             req._deadline_t = req._submit_t + req.deadline_s
+        # Ledger anchor: first-enqueue wins (a fleet submit already
+        # minted the record; a hedge/requeue hop only logs a dispatch).
+        reqledger.on_enqueue(req.rid, priority=req.priority,
+                             deadline_s=req.deadline_s,
+                             n_prompt=len(req.tokens))
+        reqledger.on_event(req.rid, "dispatch", replica=self.slo.name)
         self.waiting.append(req)
         self._gauges()
 
@@ -370,7 +377,9 @@ class ServeEngine:
             self.cancelled[rid] = list(lane.generated)
             observe.instant("serve.cancel", category="serve", rid=rid,
                             reason=reason, step=self._step_no,
-                            tokens=len(lane.generated))
+                            tokens=len(lane.generated),
+                            flow=reqledger.flow_id(rid))
+            reqledger.on_abort(rid, replica=self.slo.name, reason=reason)
             self._gauges()
             return list(lane.generated)
         for req in list(self.waiting):
@@ -378,7 +387,9 @@ class ServeEngine:
                 self.waiting.remove(req)
                 self.cancelled[rid] = []
                 observe.instant("serve.cancel", category="serve", rid=rid,
-                                reason=reason, step=self._step_no, tokens=0)
+                                reason=reason, step=self._step_no, tokens=0,
+                                flow=reqledger.flow_id(rid))
+                reqledger.on_abort(rid, replica=self.slo.name, reason=reason)
                 self._gauges()
                 return []
         return None
@@ -405,6 +416,9 @@ class ServeEngine:
             toks = self.cancel(rid, reason="deadline")
             if toks is None:  # pragma: no cover — rid just enumerated
                 continue
+            # Terminal for the ledger: spent prefill/decode time becomes
+            # guardrail time (the cancel above already ended the attempt).
+            reqledger.on_reject(rid, reason="deadline", tokens=len(toks))
             if self.on_cancel is not None:
                 self.on_cancel(rid, toks, was_active)
 
@@ -546,10 +560,13 @@ class ServeEngine:
         # Queue wait = submit → the moment a lane+pages were granted.
         # A requeued (preempted/faulted) request measures from its
         # ORIGINAL submit — the client has been waiting the whole time.
-        wait = time.perf_counter() - getattr(req, "_submit_t",
-                                             time.perf_counter())
-        observe.histogram("tdx.serve.queue_wait_s").observe(wait)
-        self.slo.observe_queue_wait(wait)
+        # One clock read; a request that never passed submit() (direct
+        # test harness) contributes no sample rather than a zero.
+        sub = getattr(req, "_submit_t", None)
+        if sub is not None:
+            wait = time.perf_counter() - sub
+            observe.histogram("tdx.serve.queue_wait_s").observe(wait)
+            self.slo.observe_queue_wait(wait)
         sid = self._next_seq
         self._next_seq += 1
         if shared:
@@ -565,6 +582,8 @@ class ServeEngine:
         if start > 0:
             observe.counter("tdx.serve.prefix_hits").inc()
             observe.counter("tdx.serve.prefix_tokens_reused").inc(start)
+        reqledger.on_admit(req.rid, replica=self.slo.name,
+                           prefix_tokens=start)
         lane = _Lane(req=req, seq_id=sid, slot=slot, length=start,
                      admitted_step=self._step_no, prefilling=True)
         try:
@@ -590,6 +609,8 @@ class ServeEngine:
                       jnp.asarray(row))
                     logits = np.asarray(logits)
                     lane.length = L
+                    reqledger.on_event(req.rid, "prefill", bucket=bucket,
+                                       n=L, replica=self.slo.name)
                 else:
                     logits = self._run_chunk(lane)  # None → more chunks
         except BaseException:
@@ -605,7 +626,10 @@ class ServeEngine:
             observe.counter("tdx.serve.preempted_requests").inc()
             observe.instant("serve.preempt", category="serve",
                             rid=req.rid, reason="prefill_fault",
-                            step=self._step_no)
+                            step=self._step_no,
+                            flow=reqledger.flow_id(req.rid))
+            reqledger.on_abort(req.rid, replica=self.slo.name,
+                               reason="prefill_fault")
             raise
         self.active[slot] = lane
         observe.counter("tdx.serve.prefills").inc()
@@ -640,6 +664,8 @@ class ServeEngine:
           jnp.asarray(row))
         lane.length = s + n
         observe.counter("tdx.serve.prefill_chunks").inc()
+        reqledger.on_chunk(req.rid, bucket=bucket, n_tokens=n,
+                           replica=self.slo.name)
         if lane.length >= L:
             return np.asarray(logits)
         return None
@@ -670,6 +696,7 @@ class ServeEngine:
                 jnp.asarray([src], jnp.int32), jnp.asarray([dst], jnp.int32),
             )
             observe.counter("tdx.serve.cow_copies").inc()
+            reqledger.on_cow(lane.req.rid, replica=self.slo.name)
 
     def _youngest_other(self, lane: _Lane) -> Optional[int]:
         others = [s for s in self.active if s != lane.slot]
@@ -714,10 +741,13 @@ class ServeEngine:
         first_delivery = self._delivered.get(req.rid, 0) == 0
         self._emit(lane, int(np.argmax(logits)), logits)
         if first_delivery:
-            ttft = time.perf_counter() - getattr(req, "_submit_t",
-                                                 time.perf_counter())
-            observe.histogram("tdx.serve.ttft_s").observe(ttft)
-            self.slo.observe_ttft(ttft)
+            # One clock read; no fabricated zero sample for a request
+            # that never passed submit() (same contract as queue wait).
+            sub = getattr(req, "_submit_t", None)
+            if sub is not None:
+                ttft = time.perf_counter() - sub
+                observe.histogram("tdx.serve.ttft_s").observe(ttft)
+                self.slo.observe_ttft(ttft)
 
     # -- decode ---------------------------------------------------------------
 
@@ -787,6 +817,15 @@ class ServeEngine:
         if n_lanes:
             self._tok_hist.observe(dt, n=n_lanes)
             self.slo.observe_token_latency(dt, n=n_lanes)
+        if reqledger.enabled():
+            # One coalesced timeline event per decode stretch per lane;
+            # the enabled() gate is hoisted so the off path costs one
+            # check per tick, not one per lane.
+            for slot in slots:
+                lane = self.active.get(slot)
+                if lane is not None:
+                    reqledger.on_decode(lane.req.rid, n_lanes=n_lanes,
+                                        replica=self.slo.name)
         for slot in slots:
             lane = self.active.get(slot)
             if lane is None:  # pragma: no cover — nothing retires mid-loop
@@ -824,6 +863,8 @@ class ServeEngine:
         self.results[lane.req.rid] = list(lane.generated)
         self.final_logits[lane.req.rid] = np.asarray(logits, np.float32)
         observe.counter("tdx.serve.requests_completed").inc()
+        reqledger.on_finish(lane.req.rid, replica=self.slo.name,
+                            tokens=len(lane.generated))
         if self.on_complete is not None:
             self.on_complete(lane.req.rid, list(lane.generated),
                              self.final_logits[lane.req.rid])
@@ -837,7 +878,10 @@ class ServeEngine:
         observe.counter("tdx.serve.preempted_requests").inc()
         observe.instant("serve.preempt", category="serve",
                         rid=lane.req.rid, reason=reason,
-                        step=self._step_no)
+                        step=self._step_no,
+                        flow=reqledger.flow_id(lane.req.rid))
+        reqledger.on_abort(lane.req.rid, replica=self.slo.name,
+                           reason=reason)
         # Fault-driven preemptions already dumped at the step level with
         # the full batch context; page-exhaustion preemptions dump here
         # (throttled per reason inside the recorder).
@@ -860,6 +904,21 @@ class ServeEngine:
                 observe.gauge("tdx.serve.tokens_per_s").set(
                     round(self._tokens_out / dt, 3)
                 )
+        # Live prefix-sharing state (docs/observability.md §Serving):
+        # visible on /metrics without a bench run.
+        observe.gauge("tdx.serve.prefix_nodes").set(self.prefix.page_count())
+        observe.gauge("tdx.serve.prefix_hit_rate").set(
+            round(self.prefix.hit_rate(), 4))
+        if reqledger.enabled():
+            reqledger.occupancy_sample(
+                replica=self.slo.name,
+                decode_busy=len(self.active),
+                decode_lanes=self.scfg.max_batch,
+                kv_pages_free=self.kv.free_pages,
+                kv_pages_shared=self.kv.shared_pages,
+                prefix_hit_rate=self.prefix.hit_rate(),
+                queue_depth=len(self.waiting),
+            )
         # Percentile publication sorts the windows — cheap, but not
         # per-tick cheap; refresh every 32 ticks and whenever the loop
         # drains (the periodic exporter also republishes on its own

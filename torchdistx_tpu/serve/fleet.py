@@ -95,6 +95,7 @@ import numpy as np
 
 from .. import chaos, observe
 from .. import config as tdx_config
+from ..observe import reqledger
 from ..models import PRESETS, TransformerConfig
 from ..utils.logging import get_logger
 from .engine import Request, ServeEngine, spin_up_replica
@@ -435,7 +436,13 @@ class ServeFleet:
         observe.counter("tdx.fleet.rejected_requests",
                         reason=rejection.reason).inc()
         observe.instant("fleet.reject", category="serve",
-                        rid=rejection.rid, reason=rejection.reason)
+                        rid=rejection.rid, reason=rejection.reason,
+                        flow=reqledger.flow_id(rejection.rid))
+        # Idempotent with the engine-side deadline finalize: a rid the
+        # engine already rejected is in the ledger's done ring and this
+        # is a no-op.
+        reqledger.on_reject(rejection.rid, reason=rejection.reason,
+                            tokens=len(rejection.tokens))
 
     def submit(self, req: Request, *,
                deadline_s: Optional[float] = None) -> None:
@@ -473,6 +480,12 @@ class ServeFleet:
         self._pending.add(req.rid)
         self._requests[req.rid] = req
         req._submit_t = time.perf_counter()
+        # Ledger t0 is FLEET admission (first on_enqueue wins), so queue
+        # attribution spans the global queue plus any requeue hops; the
+        # per-replica engine submit's on_enqueue is then a no-op.
+        reqledger.on_enqueue(req.rid, priority=req.priority,
+                             deadline_s=req.deadline_s,
+                             n_prompt=len(req.tokens))
         # End-to-end deadline, anchored at FLEET admission — queue wait
         # counts against it, and it survives requeues onto new engines.
         if req.deadline_s is not None and not hasattr(req, "_deadline_t"):
@@ -573,7 +586,13 @@ class ServeFleet:
             h.assigned.discard(req.rid)
             observe.counter("tdx.fleet.requeued_requests").inc()
             observe.instant("fleet.requeue", category="serve",
-                            rid=req.rid, replica=h.idx, reason=why)
+                            rid=req.rid, replica=h.idx, reason=why,
+                            flow=reqledger.flow_id(req.rid))
+            # A dead/killed replica never ran the engine's abort path:
+            # close its attempt here (no-op if the engine already did).
+            reqledger.on_abort(req.rid, replica=h.slo_name, reason=why)
+            reqledger.on_event(req.rid, "requeue", replica=h.idx,
+                               reason=why)
 
     def _remove(self, h: ReplicaHandle) -> None:
         h.reaped = True
@@ -739,7 +758,9 @@ class ServeFleet:
                 continue  # race still running
             observe.counter("tdx.fleet.hedge_wins").inc()
             observe.instant("fleet.hedge_win", category="serve",
-                            rid=rid, replica=winner)
+                            rid=rid, replica=winner,
+                            flow=reqledger.flow_id(rid))
+            reqledger.on_event(rid, "hedge_win", replica=winner)
             for h in self._hedges.pop(rid):
                 if h.idx != winner and h in self.handles \
                         and rid in h.assigned:
@@ -825,7 +846,10 @@ class ServeFleet:
                             "fleet.hedge", category="serve", rid=req.rid,
                             primary=h.idx, mate=mate.idx,
                             waited_s=round(waited, 4),
+                            flow=reqledger.flow_id(req.rid),
                         )
+                        reqledger.on_event(req.rid, "hedge",
+                                           primary=h.idx, mate=mate.idx)
 
     def _reject_deadline(self, rid: str, *, where: str) -> None:
         """Typed ``deadline`` rejection carrying tokens-so-far; also

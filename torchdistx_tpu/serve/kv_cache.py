@@ -42,8 +42,10 @@ contents), so no reader of a shared page ever observes a mutation.
 
 Telemetry (docs/observability.md): ``tdx.serve.kv_pages_in_use``,
 ``tdx.serve.kv_occupancy`` (used token slots / allocated slots in live
-pages — the internal-fragmentation complement), and
-``tdx.serve.kv_pool_pages`` gauges, refreshed on every mutation.
+pages — the internal-fragmentation complement),
+``tdx.serve.kv_pool_pages``, ``tdx.serve.kv_pages_free``, and
+``tdx.serve.kv_pages_shared`` (refcount > 1 — the live copy-on-write
+exposure) gauges, refreshed on every mutation.
 """
 
 from __future__ import annotations
@@ -135,6 +137,12 @@ class PagedKVCache:
     @property
     def pages_in_use(self) -> int:
         return self.cfg.usable_pages - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages with more than one reference (prefix-shared right
+        now) — the live copy-on-write exposure."""
+        return sum(1 for v in self._ref.values() if v > 1)
 
     def length(self, seq_id: int) -> int:
         return self._seqs[seq_id].length
@@ -364,6 +372,8 @@ class PagedKVCache:
         observe.gauge("tdx.serve.kv_pages_in_use").set(self.pages_in_use)
         observe.gauge("tdx.serve.kv_pool_pages").set(self.cfg.usable_pages)
         observe.gauge("tdx.serve.kv_occupancy").set(round(self.occupancy(), 4))
+        observe.gauge("tdx.serve.kv_pages_free").set(len(self._free))
+        observe.gauge("tdx.serve.kv_pages_shared").set(self.shared_pages)
 
 
 def init_pools(cfg: KVCacheConfig, dtype) -> Tuple["jax.Array", "jax.Array"]:

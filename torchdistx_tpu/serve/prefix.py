@@ -68,6 +68,11 @@ class PrefixCache:
         self._children: Dict[Tuple[int, ...], _Node] = {}
         self._tick = 0
         self._count = 0
+        # Lookup/hit tallies for the live hit-rate gauge
+        # (``tdx.serve.prefix_hit_rate``): one lookup per admission-path
+        # :meth:`match`, a hit when any prefix page matched.
+        self.lookups = 0
+        self.hits = 0
 
     # -- queries ------------------------------------------------------------
 
@@ -106,7 +111,15 @@ class PrefixCache:
             node.last_used = self._tick
             pages.append(node.page)
             children = node.children
+        self.lookups += 1
+        if pages:
+            self.hits += 1
         return pages
+
+    def hit_rate(self) -> float:
+        """Fraction of admission-path lookups that matched at least one
+        cached block (0.0 before any lookup)."""
+        return self.hits / self.lookups if self.lookups else 0.0
 
     def match_len(self, tokens: Sequence[int]) -> int:
         """Matched-prefix length in TOKENS, mutation-free and safe to
