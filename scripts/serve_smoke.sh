@@ -30,7 +30,7 @@ rep = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
 assert not rep["unwarmed"], rep["unwarmed"]
 names = {r["program"] for r in rep["program_reports"]}
 assert names == {"init", "prefill-8", "prefill-16", "chunk-8", "chunk-16",
-                 "cow", "decode"}, names
+                 "cow", "decode", "verify-2", "verify-4"}, names
 print(f"  OK: {len(names)} programs published")
 EOF
 
@@ -105,6 +105,17 @@ eng.drain()
 assert eng.kv.pages_in_use == 0  # drain releases tables AND the tree
 print(f"  OK: prefix storm == oracle, {int(hits)} prefix hits, "
       f"{int(reused)} KV tokens reused, 0 pages live after drain")
+
+# Speculative decoding ran (on by default), its verify-<k> programs
+# came from the warm set, and NO compile happened after warmup — the
+# registry-warm bring-up contract covers speculation too.
+assert eng.scfg.spec_decode and eng.spec_verify_ticks > 0, (
+    eng.scfg.spec_decode, eng.spec_verify_ticks)
+miss = snap.get("tdx.jax.compile_cache_miss", 0)
+assert miss == 0, f"storm paid {miss} local compiles with spec on"
+print(f"  OK: {eng.spec_verify_ticks} verify ticks, "
+      f"{eng.spec_accepted}/{eng.spec_drafted} drafts accepted, "
+      f"0 compiles after warmup")
 EOF
 
 echo "serve-smoke OK"

@@ -105,6 +105,14 @@ _HOST_PHASES = {
         "chunked_short_ttft_fine_s": 0.0091,
         "prefix_chunked_short_ttft_improvement": 1.31, "oracle_equal": True,
         "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
+    "serving_spec": {
+        "storm_requests": 40, "spec_off_tokens_per_s": 544.0,
+        "spec_on_tokens_per_s": 1809.0,
+        "spec_tokens_per_s_improvement": 3.322,
+        "spec_drafted": 350, "spec_accepted": 230,
+        "spec_verify_ticks": 39, "spec_accept_rate": 0.657,
+        "spec_accepted_per_verify": 5.846, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "serving_ledger": {
         "storm_requests": 48, "ledger_off_tokens_per_s": 661.0,
         "ledger_on_tokens_per_s": 657.0, "ledger_overhead_ratio": 0.994,

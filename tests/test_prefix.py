@@ -28,6 +28,7 @@ from torchdistx_tpu import chaos, observe
 from torchdistx_tpu.models import TransformerConfig
 from torchdistx_tpu.serve import (
     KVCacheConfig,
+    NgramDrafter,
     OutOfPages,
     PagedKVCache,
     PrefixCache,
@@ -216,6 +217,186 @@ def test_tree_match_is_page_aligned_and_lru_evicts_leaves():
     assert tree.pages() == [root_page]
     assert tree.evict() and len(tree) == 0
     assert not tree.evict()
+    assert kv.pages_in_use == 0
+
+
+def test_rollback_retracts_pages_and_refcounts():
+    """Token-level rollback (speculative decoding): the trailing pages a
+    shorter length no longer needs return to the free list; a rollback
+    that stays within the tail page is bookkeeping only."""
+    cfg = KVCacheConfig(n_layers=1, kv_heads=1, head_dim=4,
+                        page_size=4, n_pages=16)
+    kv = PagedKVCache(cfg)
+    kv.alloc(1, 10)                          # 3 pages
+    assert kv.rollback(1, 10) == 0           # no-op at the same length
+    assert kv.rollback(1, 9) == 0            # same page count, shorter
+    assert kv.length(1) == 9
+    assert kv.rollback(1, 5) == 1            # drops the third page
+    assert len(kv.page_ids(1)) == 2
+    assert kv.rollback(1, 0) == 2
+    assert kv.page_ids(1) == []
+    with pytest.raises(ValueError, match="rollback target"):
+        kv.rollback(1, 1)                    # beyond the current length
+    with pytest.raises(ValueError, match="rollback target"):
+        kv.rollback(1, -1)
+    kv.free(1)
+    assert kv.pages_in_use == 0
+    assert not kv._ref
+
+
+def test_rollback_on_shared_pages_drops_only_own_reference():
+    """Rolling a lane back through COW-shared territory retracts only
+    THAT lane's references: the tree and every other reader keep the
+    pages, contents untouched."""
+    cfg = KVCacheConfig(n_layers=1, kv_heads=1, head_dim=4,
+                        page_size=4, n_pages=16)
+    kv = PagedKVCache(cfg)
+    tree = PrefixCache(kv)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]          # two full pages
+    kv.alloc(1, len(toks))
+    tree.insert(toks, kv.page_ids(1))
+    kv.alloc_shared(2, tree.match(toks), len(toks))
+    shared = kv.page_ids(2)
+    kv.extend(2, 9)                          # a private third page
+    assert kv.rollback(2, 8) == 1            # drops only the private page
+    assert kv.page_ids(2) == shared
+    assert kv.rollback(2, 3) == 1            # back into the shared blocks
+    assert kv.ref(shared[1]) == 2            # seq 1 + the tree survive
+    assert kv.page_ids(1) == shared
+    assert set(tree.pages()) == set(shared)
+    _assert_refs_consistent(kv, tree)
+    kv.free(1)
+    kv.free(2)
+    tree.clear()
+    assert kv.pages_in_use == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_spec_rollback_refcounts_under_random_accept_reject(seed):
+    """The speculative-decode KV contract (ISSUE 19): random verify
+    cycles — extend by k+1, accept a random draft prefix, roll back the
+    rest — interleaved with sharing, COW, frees, and evictions keep
+    every refcount equal to its live references, and a drain leaves all
+    of them zero."""
+    rng = random.Random(1000 + seed)
+    cfg = KVCacheConfig(n_layers=1, kv_heads=1, head_dim=4,
+                        page_size=4, n_pages=rng.randrange(10, 16))
+    kv = PagedKVCache(cfg)
+    tree = PrefixCache(kv)
+    next_sid = 1
+    lanes: dict = {}  # sid -> token list (kept in sync with kv.length)
+    for _ in range(400):
+        op = rng.random()
+        if op < 0.3:  # admit (with sharing when the tree matches)
+            toks = [rng.randrange(4) for _ in range(rng.randrange(1, 13))]
+            shared = tree.match(toks)
+            need = cfg.pages_for(len(toks)) - len(shared)
+            if need <= kv.free_pages:
+                sid = next_sid
+                next_sid += 1
+                if shared:
+                    kv.alloc_shared(sid, shared, len(toks))
+                else:
+                    kv.alloc(sid, len(toks))
+                lanes[sid] = toks
+        elif op < 0.45 and lanes:  # publish a prompt's full blocks
+            sid = rng.choice(list(lanes))
+            toks = lanes[sid]
+            nfull = len(toks) // cfg.page_size
+            if nfull:
+                tree.insert(toks[:nfull * cfg.page_size],
+                            kv.page_ids(sid)[:nfull])
+        elif op < 0.75 and lanes:  # one verify tick: extend, accept, roll back
+            sid = rng.choice(list(lanes))
+            k = rng.randrange(1, 5)
+            length = kv.length(sid)
+            try:
+                kv.extend(sid, length + k + 1)
+            except OutOfPages:
+                continue
+            accepted = rng.randrange(0, k + 1)
+            kv.rollback(sid, length + accepted + 1)
+            lanes[sid] = lanes[sid] + [rng.randrange(4)
+                                       for _ in range(accepted + 1)]
+        elif op < 0.85 and lanes:  # retire / preempt
+            sid = rng.choice(list(lanes))
+            kv.free(sid)
+            del lanes[sid]
+        elif op < 0.92:  # evict one LRU cache leaf
+            tree.evict()
+        elif lanes:  # copy-on-write a random owned page
+            sid = rng.choice(list(lanes))
+            pages = kv.page_ids(sid)
+            if pages:
+                try:
+                    kv.cow_page(sid, rng.randrange(len(pages)))
+                except OutOfPages:
+                    pass
+        _assert_refs_consistent(kv, tree)
+    for sid in list(lanes):
+        kv.free(sid)
+    tree.clear()
+    assert kv.pages_in_use == 0
+    assert not kv._ref
+    assert len(tree) == 0
+
+
+# ---------------------------------------------------------------------------
+# the n-gram drafter (speculative decoding's proposer)
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_drafter_observe_draft_recency_and_capacity():
+    d = NgramDrafter(order=2, max_entries=4)
+    assert len(d) == 0
+    assert d.draft([1, 2, 3], 4) == []       # empty map proposes nothing
+    assert d.observe([1, 2, 3, 4, 5]) == 3   # (1,2)->3 (2,3)->4 (3,4)->5
+    assert len(d) == 3 and d.observed == 3
+    assert d.draft([0, 1, 2], 3) == [3, 4, 5]
+    assert d.draft([0, 1, 2], 2) == [3, 4]   # k caps the walk
+    assert d.draft([9, 9], 3) == []          # unknown tail
+    assert d.draft([1], 3) == []             # context shorter than order
+    assert d.draft([0, 1, 2], 0) == []
+    d.observe([2, 3, 9])                     # recency: last writer wins
+    assert d.draft([1, 2], 2) == [3, 9]
+    d.observe([7, 7, 7])                     # the 4th entry fills the cap
+    assert len(d) == 4
+    d.observe([8, 8, 8])                     # at capacity: new gram dropped
+    assert len(d) == 4
+    assert d.draft([8, 8], 1) == []
+    d.observe([1, 2, 6])                     # ...but known grams update
+    assert d.draft([1, 2], 1) == [6]
+    assert d.proposed > 0
+    with pytest.raises(ValueError, match="order"):
+        NgramDrafter(order=0)
+    with pytest.raises(ValueError, match="max_entries"):
+        NgramDrafter(max_entries=0)
+
+
+def test_token_streams_feed_drafter_warmup():
+    """token_streams() replays every cached root-to-leaf prefix;
+    warm_from_prefix absorbs them so a fresh replica drafts the hot
+    preambles without re-reading any request."""
+    cfg = KVCacheConfig(n_layers=1, kv_heads=1, head_dim=4,
+                        page_size=4, n_pages=16)
+    kv = PagedKVCache(cfg)
+    tree = PrefixCache(kv)
+    assert tree.token_streams() == []
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    kv.alloc(1, len(toks))
+    tree.insert(toks, kv.page_ids(1))
+    kv.alloc_shared(2, tree.match(toks[:4]), 8)
+    branch = toks[:4] + [9, 9, 9, 9]
+    tree.insert(branch, kv.page_ids(2))
+    assert sorted(tree.token_streams()) == sorted([toks, branch])
+    d = NgramDrafter(order=2)
+    assert d.warm_from_prefix(tree) == 12    # 6 gram pairs per stream
+    assert len(d) == 8                       # shared-root grams dedup
+    assert d.draft([1, 2], 2) == [3, 4]
+    assert d.draft([9, 9], 1) == [9]
+    kv.free(1)
+    kv.free(2)
+    tree.clear()
     assert kv.pages_in_use == 0
 
 

@@ -163,6 +163,74 @@ def test_decode_ticks_coalesce_into_one_event(ledger):
     assert detail["cow_copies"] == 1
 
 
+def test_spec_ticks_coalesce_into_verify_events_in_decode_stage(ledger):
+    """Speculative verify ticks are the decode stage's sibling event
+    (ISSUE 19): they coalesce like decode ticks, tally drafted /
+    accepted / emitted, keep the four-stage sum-to-e2e contract, and
+    surface per-request speculation totals in the summary."""
+    rid = "sp-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    time.sleep(0.002)                      # prefill
+    for _ in range(4):
+        reqledger.on_spec(rid, drafted=3, accepted=2, emitted=3,
+                          n_lanes=2, replica="serve-r1")
+    reqledger.on_cow(rid, replica="serve-r1")
+    reqledger.on_spec(rid, drafted=2, accepted=0, emitted=1, n_lanes=1)
+    reqledger.on_decode(rid, n_lanes=1)    # a plain tick interleaves fine
+    time.sleep(0.002)                      # decode
+    reqledger.on_finish(rid, tokens=14)
+
+    detail = reqledger.summary(rid)
+    assert _kinds(detail) == ["enqueue", "admit", "verify", "cow",
+                              "verify", "decode", "finish"]
+    first, second = [e for e in detail["events"] if e["k"] == "verify"]
+    assert first["ticks"] == 4 and first["drafted"] == 12
+    assert first["accepted"] == 8 and first["toks"] == 12
+    assert second["ticks"] == 1 and second["accepted"] == 0
+    assert detail["tokens"] == 14
+    assert detail["spec_drafted"] == 14
+    assert detail["spec_accepted"] == 8
+    assert detail["spec_ticks"] == 5
+    # spec time lands in decode: the stage sum stays exact
+    assert detail["decode_s"] > 0.0
+    assert abs(_stage_sum(detail) - detail["e2e_s"]) < 1e-4, detail
+
+
+def test_summary_omits_spec_fields_without_speculation(ledger):
+    """Plain-decode requests carry no speculation keys — the summary
+    vocabulary only grows where spec actually ran (TDX_SPEC_DECODE=0
+    keeps old dashboards byte-identical)."""
+    rid = "nosp-1"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_decode(rid, n_lanes=1)
+    reqledger.on_finish(rid, tokens=1)
+    detail = reqledger.summary(rid)
+    assert "spec_ticks" not in detail
+    assert "spec_drafted" not in detail and "spec_accepted" not in detail
+
+
+def test_autopsy_reports_spec_summary(ledger, tmp_path):
+    """``tdx_trace.py autopsy`` surfaces the request's speculation
+    tallies and the coalesced verify event from the flushed terminal
+    instant."""
+    trace_dir = tmp_path / "traces"
+    rid = "sp-auto"
+    reqledger.on_enqueue(rid)
+    reqledger.on_admit(rid, replica="serve-r1")
+    reqledger.on_spec(rid, drafted=4, accepted=3, emitted=4, n_lanes=1)
+    reqledger.on_finish(rid, tokens=4)
+    observe.flush(trace_dir=str(trace_dir))
+    proc = subprocess.run(
+        [sys.executable, CLI, "autopsy", rid, str(trace_dir)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "speculation: drafted=4  accepted=3" in proc.stdout
+    assert "verify" in proc.stdout
+
+
 def test_event_timeline_bounded_with_drop_count(ledger):
     """``TDX_LEDGER_EVENTS`` caps per-request memory: overflow evicts
     the oldest events and counts them, never grows without bound."""
@@ -187,6 +255,8 @@ def test_kill_switch_records_nothing(ledger):
         reqledger.on_enqueue("ks-1", priority=0)
         reqledger.on_admit("ks-1", replica="serve-r1")
         reqledger.on_decode("ks-1", n_lanes=1)
+        reqledger.on_spec("ks-1", drafted=2, accepted=1, emitted=2,
+                          n_lanes=1)
         reqledger.on_finish("ks-1", tokens=1)
         reqledger.occupancy_sample(decode_busy=1, decode_lanes=2)
     assert reqledger.summary("ks-1") is None
@@ -535,3 +605,80 @@ def test_fleet_storm_hedge_kill_requeue_one_flow_and_autopsy(
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_fleet_spec_storm_kill_hedge_deadline_bitwise_and_clean_ledger(
+        shared_cache):
+    """ISSUE 19 acceptance: a spec-on fleet storm (speculation is the
+    default) under a chaos replica kill, zero-threshold hedging, and one
+    hopeless deadline — every completed output is bitwise-equal to the
+    oracle, every finished request's stages still sum to e2e with the
+    verify events folded into the decode stage, and no KV pages leak."""
+    gc = GuardrailConfig(breaker=False, brownout=False,
+                         hedging=True, hedge_wait_frac=0.0)
+    observe.enable(True)
+    observe.reset()
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            fl = ServeFleet(
+                LLAMA, family="llama", serve_cfg=SCFG,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=2,
+                                      autoscale=False, stall_s=60.0,
+                                      guardrails=gc),
+            )
+            with fl:
+                fl.start(2, timeout=240.0)
+                chaos.install("fleet@2=raise")
+                try:
+                    # One shared prompt: repeats teach every replica's
+                    # drafter the chain, so speculation provably fires.
+                    prompt = [9, 4, 1, 4, 9, 2]
+                    reqs = [Request(f"sp{i}", list(prompt),
+                                    max_new_tokens=4 + (i % 2),
+                                    deadline_s=(0.001 if i == 7 else 120.0),
+                                    arrival_step=i)
+                            for i in range(8)]
+                    out = fl.run(reqs, max_seconds=240.0)
+                finally:
+                    chaos.clear()
+                spec_ticks = sum(
+                    h.engine.spec_verify_ticks for h in fl.handles
+                    if h.engine is not None)
+                spec_accepted = sum(
+                    h.engine.spec_accepted for h in fl.handles
+                    if h.engine is not None)
+                assert spec_ticks > 0, "the storm never speculated"
+                assert spec_accepted > 0, "repeats must accept drafts"
+                for r in reqs:
+                    if r.rid in out:
+                        assert r.rid not in fl.rejected, r.rid
+                        _check_oracle(fl, [r], out)
+                        summ = reqledger.summary(r.rid)
+                        assert summ is not None and \
+                            summ["outcome"] == "ok", r.rid
+                        assert abs(_stage_sum(summ) - summ["e2e_s"]) \
+                            < 5e-3, summ
+                    else:
+                        assert fl.rejected[r.rid].reason == "deadline", r.rid
+                # the verify events rode inside the decode stage
+                spec_rids = [
+                    r.rid for r in reqs if r.rid in out
+                    and (reqledger.summary(r.rid) or {}).get("spec_ticks")]
+                assert spec_rids, "no finished request carried speculation"
+                detail = reqledger.summary(spec_rids[0])
+                assert "verify" in _kinds(detail)
+                assert detail["decode_s"] > 0.0
+                assert detail["spec_drafted"] >= detail["spec_accepted"] > 0 \
+                    or detail["spec_accepted"] == 0
+                # no KV pages leak past the storm on the survivors (the
+                # chaos-killed replica's engine froze mid-batch; its
+                # requests were requeued, its pool is garbage by design)
+                for h in fl.handles:
+                    if (h.state == "serving" and h.engine is not None
+                            and h.engine.k_pages is not None):
+                        assert h.engine.kv.pages_in_use == \
+                            h.engine.prefix.page_count(), h.idx
+    finally:
+        observe.enable(None)
+        observe.health.reset()

@@ -284,7 +284,8 @@ def test_registry_warmed_bring_up_zero_local_compiles():
         assert summary["programs"] == len(summary["program_reports"])
         names = {r["program"] for r in summary["program_reports"]}
         assert names == {"init", "prefill-8", "prefill-16",
-                         "chunk-8", "chunk-16", "cow", "decode"}
+                         "chunk-8", "chunk-16", "cow", "decode",
+                         "verify-2", "verify-4"}
 
         mat._reset_cache_binding()
         base = {r["name"]: r["value"]
@@ -334,14 +335,132 @@ def test_program_fingerprints_are_shape_sensitive():
     d = {s.name: s.program_fp
          for s in serve_program_specs("llama", LLAMA, SCFG, seed=1)}
     assert d["init"] != a["init"]
-    # max_new_tokens / prefill_chunk / prefix_cache are host-side knobs
-    # no compiled program reads: changing them must NOT invalidate a
-    # warmed registry.
+    # max_new_tokens / prefill_chunk / prefix_cache / spec_decode /
+    # spec_k are host-side knobs no compiled program reads: changing
+    # them must NOT invalidate a warmed registry.
     e = {s.name: s.program_fp
          for s in serve_program_specs(
              "llama", LLAMA,
              ServeConfig(max_batch=2, page_size=8, n_pages=16,
                          max_pages_per_seq=3, prefill_buckets=(8, 16),
                          max_new_tokens=99, prefill_chunk=5,
-                         prefix_cache=False))}
+                         prefix_cache=False, spec_decode=False,
+                         spec_k=2))}
     assert e == a
+    # ...while spec_buckets IS a shape knob: it picks which verify-<k>
+    # programs exist (each one's own fp depends only on its k).
+    assert {"verify-2", "verify-4"} <= set(a)
+    f = {s.name: s.program_fp
+         for s in serve_program_specs(
+             "llama", LLAMA,
+             ServeConfig(max_batch=2, page_size=8, n_pages=16,
+                         max_pages_per_seq=3, prefill_buckets=(8, 16),
+                         spec_buckets=(3,)))}
+    assert "verify-3" in f and "verify-4" not in f
+    assert f["decode"] == a["decode"]
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE 19): drafts accepted, bitwise-oracle kept
+# ---------------------------------------------------------------------------
+
+
+def test_spec_decode_accepts_drafts_and_matches_oracle(llama_engine):
+    """Self-drafting: after one generation taught the drafter a greedy
+    chain, a repeat of the same prompt must accept draft tokens (more
+    than one token per verify tick) while staying bitwise-equal to the
+    unbatched oracle."""
+    eng = llama_engine
+    assert eng.scfg.spec_decode and eng._drafter is not None
+    r1 = Request("sp-a", [23, 42, 17], max_new_tokens=6)
+    out1 = eng.run([r1])
+    _check_oracle(eng, [r1], out1)
+    ticks0 = eng.spec_verify_ticks
+    drafted0, accepted0 = eng.spec_drafted, eng.spec_accepted
+    r2 = Request("sp-b", [23, 42, 17], max_new_tokens=6)
+    out2 = eng.run([r2])
+    _check_oracle(eng, [r2], out2)
+    assert out2["sp-b"] == out1["sp-a"]
+    assert eng.spec_verify_ticks > ticks0
+    assert eng.spec_drafted > drafted0
+    # The repeat's whole chain was in the drafter: accepts happened, so
+    # the run took fewer verify ticks than it emitted tokens.
+    accepted = eng.spec_accepted - accepted0
+    assert accepted > 0, (eng.spec_drafted - drafted0, accepted)
+    assert eng.spec_verify_ticks - ticks0 < 6
+
+
+def test_spec_kill_switch_serves_plain_decode(llama_params, llama_engine):
+    """``spec_decode=False`` (the TDX_SPEC_DECODE=0 path): no drafter,
+    no verify ticks, identical tokens — the switch trades throughput,
+    never output."""
+    scfg = ServeConfig(max_batch=2, page_size=8, n_pages=16,
+                       max_pages_per_seq=3, prefill_buckets=(8, 16),
+                       spec_decode=False)
+    eng = ServeEngine("llama", LLAMA, llama_params, serve_cfg=scfg)
+    eng._programs.update(llama_engine._programs)
+    assert eng._drafter is None and not eng.scfg.spec_decode
+    reqs = [Request("ks-a", [23, 42, 17], max_new_tokens=5),
+            Request("ks-b", [7] * 9, max_new_tokens=4)]
+    out = eng.run(reqs)
+    _check_oracle(eng, reqs, out)
+    assert eng.spec_verify_ticks == 0 and eng.spec_drafted == 0
+    # the env-var spelling resolves the same way
+    with tdx_config.override(spec_decode=False):
+        eng2 = ServeEngine("llama", LLAMA, llama_params, serve_cfg=SCFG)
+    assert eng2._drafter is None and not eng2.scfg.spec_decode
+
+
+def test_spec_decode_through_preemption_matches_oracle(llama_params):
+    """Page-pool preemption while lanes are speculating: draft shedding
+    plus token-level KV rollback keep every output bitwise-equal to the
+    oracle and the preempted lane's requeue intact."""
+    scfg = ServeConfig(max_batch=2, page_size=4, n_pages=7,
+                       max_pages_per_seq=6, prefill_buckets=(8,))
+    eng = ServeEngine("llama", LLAMA, llama_params, serve_cfg=scfg)
+    observe.enable(True)
+    try:
+        before = observe.counter("tdx.serve.preempted_requests").value
+        # Repetitive prompts make the n-gram drafter propose from the
+        # first decode tick, so speculation is live when the pool runs dry.
+        reqs = [
+            Request("pp0", [7] * 6, max_new_tokens=8),
+            Request("pp1", [7, 7, 7, 9, 9, 9], max_new_tokens=8),
+        ]
+        out = eng.run(reqs)
+        assert observe.counter("tdx.serve.preempted_requests").value > before
+        assert eng.spec_drafted > 0
+        _check_oracle(eng, reqs, out)
+    finally:
+        observe.enable(None)
+    eng.drain()
+    assert eng.kv.pages_in_use == 0
+
+
+def test_chaos_raise_verify_requeues_and_converges(llama_params,
+                                                   llama_engine):
+    """serve@N=raise:verify fires at the next speculative verify tick —
+    after drafting and KV growth, before accept/rollback: active lanes
+    requeue and regenerate, outputs equal the fault-free oracle, and no
+    pages leak."""
+    eng = ServeEngine("llama", LLAMA, llama_params, serve_cfg=SCFG)
+    eng._programs.update(llama_engine._programs)
+    observe.enable(True)
+    # Teach the drafter this chain so the targeted tick really drafts.
+    warm = Request("vf-w", [7] * 8, max_new_tokens=4)
+    eng.run([warm])
+    chaos.install(f"serve@{eng._step_no + 3}=raise:verify")
+    try:
+        before = observe.counter("tdx.serve.preempted_requests").value
+        reqs = [Request("vf-a", [7] * 8, max_new_tokens=6),
+                Request("vf-b", [9, 8, 7, 6], max_new_tokens=4)]
+        out = eng.run(reqs)
+        assert not chaos.active_plan().pending(), "the fault never fired"
+        assert observe.counter("tdx.serve.preempted_requests").value > before
+        _check_oracle(eng, reqs, out)
+    finally:
+        chaos.clear()
+        observe.enable(None)
+    eng.drain()
+    assert eng.kv.pages_in_use == 0
+    assert not eng.kv._ref

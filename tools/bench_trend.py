@@ -80,6 +80,16 @@ GATES: List[Tuple[str, str, float]] = [
     # same loose floor.
     (r"^prefix_tokens_per_s_improvement$", "up", 0.50),
     (r"^prefix_p95_ttft_improvement$", "up", 0.50),
+    # Speculative decoding (bench.py serving_spec phase, r19 on):
+    # spec-on vs spec-off tokens/s on the same shared-preamble storm,
+    # and the realized draft accept rate.  The phase gates improvement
+    # > 1 and accepted-per-verify > 1 absolutely; the trend gates catch
+    # the win (or the drafter) quietly decaying across rounds.  The
+    # ratio is a sub-second same-host storm ratio (same class as the
+    # prefix headline → same loose floor); the accept rate is a
+    # model/drafter property, much steadier than wall clock.
+    (r"^spec_tokens_per_s_improvement$", "up", 0.50),
+    (r"^spec_accept_rate$", "up", 0.30),
     # Request-ledger overhead (bench.py serving_ledger phase, r17 on):
     # tokens/s with the per-request ledger on / off, same storm.  The
     # phase gates >= 0.98 absolutely (the <=2% overhead claim); the

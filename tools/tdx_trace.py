@@ -589,6 +589,17 @@ def autopsy_report(events: List[dict], flight_docs: List[dict],
         head.append(f"cow={detail['cow_copies']}")
     lines.append("  " + "  ".join(head))
 
+    # Speculative-decoding summary (present only when verify ticks ran
+    # for this request): how much the drafter proposed, how much
+    # survived verify, and the realized accept rate.
+    if detail.get("spec_ticks"):
+        drafted = int(detail.get("spec_drafted", 0))
+        accepted = int(detail.get("spec_accepted", 0))
+        rate = f"{accepted / drafted:.1%}" if drafted else "n/a"
+        lines.append(
+            f"  speculation: drafted={drafted}  accepted={accepted}  "
+            f"verify_ticks={detail['spec_ticks']}  accept_rate={rate}")
+
     e2e = detail.get("e2e_s")
     stage_sum = sum(float(detail.get(f"{st}_s", 0.0))
                     for st in AUTOPSY_STAGES)

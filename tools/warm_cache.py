@@ -267,9 +267,12 @@ def warm(factory, cache_dir, *, mesh=None, plan=None, param_dtype=None,
 def warm_decode(model_name, cache_dir, *, registry_dir=None, serve_cfg=None,
                 seed=0, param_dtype=None, mesh=None, plan=None) -> dict:
     """Warm the SERVING program set of a model-zoo preset — the
-    deferred-init parameter program, every prefill bucket, and the
-    decode program — via :func:`torchdistx_tpu.serve.warm_serving`, so a
-    later ``spin_up_replica`` of the same shape is all-hit end to end."""
+    deferred-init parameter program, every prefill/chunk bucket, the
+    cow + decode programs, and every speculative ``verify-<k>`` bucket
+    — via :func:`torchdistx_tpu.serve.warm_serving`, so a later
+    ``spin_up_replica`` of the same shape is all-hit end to end, with
+    speculation on or off (the warm set ignores the host-side
+    ``TDX_SPEC_DECODE`` toggle so one registry serves both)."""
     from torchdistx_tpu.models import PRESETS, TransformerConfig
     from torchdistx_tpu.serve import warm_serving
     from torchdistx_tpu.serve.programs import model_family

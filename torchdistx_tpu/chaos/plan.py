@@ -34,6 +34,11 @@ Examples::
     serve@3=raise                # replica fault at engine step 3: every
                                  # active request is requeued and
                                  # regenerated (recompute preemption)
+    serve@3=raise:chunk          # deferred to the next prefill-chunk
+                                 # boundary (mid-chunked-prefill fault)
+    serve@3=raise:verify         # deferred to the next speculative
+                                 # verify tick — after drafting and KV
+                                 # growth, before accept/rollback
     fleet@2=raise                # kill fleet replica 2 mid-batch: its
                                  # active requests requeue onto the
                                  # surviving replicas

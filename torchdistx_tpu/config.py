@@ -158,6 +158,20 @@ Environment variables (read at first import):
                         scheduling knob: the compiled program set is
                         identical at every setting (see docs/serving.md
                         §Prefix sharing & chunked prefill).
+``TDX_SPEC_DECODE``     "0" disables speculative decoding on the serving
+                        hot path (:mod:`torchdistx_tpu.serve`): the
+                        self-drafting n-gram drafter, the batched
+                        ``verify-<k>`` tick, and KV rollback.  On by
+                        default — greedy accept keeps every completion
+                        bitwise-equal to the unbatched oracle, so the
+                        kill switch trades only throughput (see
+                        docs/serving.md §Speculative decoding).
+``TDX_SPEC_K``          Max draft length per lane per verify tick
+                        (default 4, clamped to the largest compiled
+                        verify bucket).  A host-side scheduling knob:
+                        the compiled ``verify-<k>`` program set is
+                        fixed by ``ServeConfig.spec_buckets``, not by
+                        this value.
 ``TDX_REQUEST_LEDGER``  "0" disables the per-request attribution ledger
                         (:mod:`torchdistx_tpu.observe.reqledger`): the
                         serve stack's per-request typed event timeline,
@@ -224,6 +238,8 @@ class Config:
     materialize_batch_put: bool = True
     reshard_chunk_mb: float = 64.0
     prefill_chunk: int = 0
+    spec_decode: bool = True
+    spec_k: int = 4
     request_ledger: bool = True
     ledger_events: int = 128
 
@@ -266,6 +282,8 @@ def _from_env() -> Config:
         ),
         reshard_chunk_mb=float(os.environ.get("TDX_RESHARD_CHUNK_MB", "64")),
         prefill_chunk=int(os.environ.get("TDX_PREFILL_CHUNK", "0")),
+        spec_decode=os.environ.get("TDX_SPEC_DECODE", "1") != "0",
+        spec_k=int(os.environ.get("TDX_SPEC_K", "4")),
         request_ledger=os.environ.get("TDX_REQUEST_LEDGER", "1") != "0",
         ledger_events=int(os.environ.get("TDX_LEDGER_EVENTS", "128")),
     )

@@ -8,8 +8,10 @@ This module is the missing per-request layer:
 * **Request ledger** — every request the serve stack touches carries a
   compact typed event timeline (enqueue, dispatch, admit with
   prefix-hit length, each ``chunk-<bucket>`` prefill tick, coalesced
-  decode ticks, COW copies, hedge start/win/loser-cancel, preemption /
-  requeue, deadline cancel, finish / shed) plus a stage state machine
+  decode ticks, coalesced speculative ``verify`` ticks with
+  drafted/accepted tallies, COW copies, hedge start/win/loser-cancel,
+  preemption / requeue, deadline cancel, finish / shed) plus a stage
+  state machine
   that decomposes end-to-end latency into **queue / prefill / decode /
   guardrail** time *by construction*: every wall-clock interval between
   enqueue and the terminal event lands in exactly one bucket, and an
@@ -72,6 +74,7 @@ __all__ = [
     "on_event",
     "on_finish",
     "on_reject",
+    "on_spec",
     "requests_report",
     "reset",
     "summary",
@@ -110,6 +113,7 @@ class _Record:
         "acc", "att", "active", "attempts", "priority", "deadline_s",
         "n_prompt", "prefix_tokens", "hedged", "cow_copies", "tokens",
         "outcome", "e2e_s", "_decode_ev",
+        "spec_drafted", "spec_accepted", "spec_ticks", "_spec_ev",
     )
 
     def __init__(self, rid: str, now: float, flow: Optional[int],
@@ -136,6 +140,15 @@ class _Record:
         self.outcome: Optional[str] = None
         self.e2e_s: Optional[float] = None
         self._decode_ev: Optional[dict] = None
+        # Speculative-decoding tallies (docs/serving.md §Speculative
+        # decoding): verify ticks coalesce like decode ticks, and the
+        # draft/verify/accept work all lands in DECODE stage time —
+        # speculation changes how decode time is spent, not the stage
+        # decomposition.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_ticks = 0
+        self._spec_ev: Optional[dict] = None
 
     # -- stage machine ---------------------------------------------------
 
@@ -153,7 +166,10 @@ class _Record:
         if new_stage is not None and new_stage != self.stage:
             self.stage = new_stage
             if new_stage == "decode":
-                self._decode_ev = None  # next tick opens a fresh event
+                # Next tick opens a fresh coalesced event (plain decode
+                # and verify stretches alike).
+                self._decode_ev = None
+                self._spec_ev = None
 
     def fold_attempt(self, *, ok: bool) -> None:
         """End the current attempt: its prefill/decode time becomes real
@@ -193,6 +209,12 @@ class _Record:
             "decode_s": round(self.acc["decode"], 6),
             "guardrail_s": round(self.acc["guardrail"], 6),
         }
+        if self.spec_ticks:
+            # Only when speculation actually ran: requests served with
+            # spec off (or all-plain ticks) keep the historical shape.
+            out["spec_drafted"] = self.spec_drafted
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_ticks"] = self.spec_ticks
         if self.priority is not None:
             out["priority"] = self.priority
         if self.n_prompt is not None:
@@ -343,6 +365,41 @@ def on_decode(rid: str, *, n_lanes: int, replica: str = "local") -> None:
         ev["lanes"] = n_lanes
         ev["t_last"] = round(now - rec.t0, 6)
         rec.tokens += 1
+
+
+def on_spec(rid: str, *, drafted: int, accepted: int, emitted: int,
+            n_lanes: int, replica: str = "local") -> None:
+    """One speculative verify tick for this request: ``drafted`` tokens
+    proposed, ``accepted`` of them kept, ``emitted`` tokens delivered
+    (accepted + one corrected/bonus token).  Ticks coalesce into ONE
+    in-place-updated ``verify`` event per decode stretch — the
+    speculative sibling of :func:`on_decode` — and the time lands in
+    the decode stage, so the four-stage sum-to-e2e contract is
+    untouched by speculation."""
+    if not enabled():
+        return
+    now = time.perf_counter()
+    with _LOCK:
+        rec = _get(rid)
+        if rec is None:
+            return
+        rec.touch(now, "decode")
+        ev = rec._spec_ev
+        if ev is None or rec.events[-1] is not ev:
+            ev = rec.add_event(now, "verify", ticks=0, drafted=0,
+                               accepted=0, toks=0, lanes=n_lanes,
+                               replica=replica)
+            rec._spec_ev = ev
+        ev["ticks"] += 1
+        ev["drafted"] += drafted
+        ev["accepted"] += accepted
+        ev["toks"] += emitted
+        ev["lanes"] = n_lanes
+        ev["t_last"] = round(now - rec.t0, 6)
+        rec.tokens += emitted
+        rec.spec_drafted += drafted
+        rec.spec_accepted += accepted
+        rec.spec_ticks += 1
 
 
 def on_cow(rid: str, *, replica: str = "local") -> None:

@@ -57,7 +57,7 @@ from .guardrails import (
     should_hedge,
 )
 from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
-from .prefix import PrefixCache
+from .prefix import NgramDrafter, PrefixCache
 from .router import (
     AdmissionQueue,
     FleetRejected,
@@ -72,6 +72,7 @@ from .programs import (
     build_cow_fn,
     build_decode_fn,
     build_prefill_fn,
+    build_verify_fn,
     compile_serving_program,
     serve_program_specs,
     warm_serving,
@@ -86,6 +87,7 @@ __all__ = [
     "FleetRejected",
     "GuardrailConfig",
     "KVCacheConfig",
+    "NgramDrafter",
     "QuarantineEntry",
     "OutOfPages",
     "PagedKVCache",
@@ -101,6 +103,7 @@ __all__ = [
     "build_cow_fn",
     "build_decode_fn",
     "build_prefill_fn",
+    "build_verify_fn",
     "compile_serving_program",
     "init_pools",
     "least_outstanding",

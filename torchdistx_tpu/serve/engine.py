@@ -35,6 +35,17 @@ contents never change while anyone else can read them.  Under pool
 pressure the engine EVICTS cache leaves (LRU) before it will preempt a
 running lane.
 
+Decode is **speculative** by default (``TDX_SPEC_DECODE=0`` kills it):
+a host-side n-gram drafter (:class:`.prefix.NgramDrafter`) fed by
+admitted prompts and each lane's own emitted tokens proposes up to
+``spec_k`` tokens per lane, and one bucketed ``verify-<k>`` program
+call scores all k+1 positions for every lane at once.  Greedy accept
+keeps the longest draft prefix matching the verify argmaxes plus one
+corrected (or bonus) token; :meth:`PagedKVCache.rollback` retracts the
+rejected positions' K/V, so cache state and every emitted token stay
+bitwise what plain decode would produce — speculation is purely a
+throughput knob (docs/serving.md §Speculative decoding).
+
 When the pool cannot cover a lane's growth the engine **preempts** the
 youngest lane (frees its pages, requeues the whole request at the front
 of the queue — greedy decode regenerates it identically), the vLLM
@@ -83,7 +94,7 @@ from ..observe import reqledger
 from ..models import PRESETS, TransformerConfig
 from ..utils.logging import get_logger
 from .kv_cache import OutOfPages, PagedKVCache, init_pools
-from .prefix import PrefixCache
+from .prefix import NgramDrafter, PrefixCache
 from .programs import (
     ResolvedServeConfig,
     ServeConfig,
@@ -130,6 +141,7 @@ class _Lane:
     generated: List[int] = field(default_factory=list)
     admitted_step: int = 0
     prefilling: bool = False       # mid-chunked-prefill; decode skips it
+    spec_k: int = 0                # current draft length cap (adaptive)
 
 
 class ServeEngine:
@@ -174,6 +186,19 @@ class ServeEngine:
         # deferred here by step() and fired BETWEEN prefill chunks —
         # the mid-chunked-prefill fault the failure matrix pins.
         self._pending_chunk_faults: List[chaos.Fault] = []
+        # Same deferral for ``raise:verify`` — fired right before the
+        # next speculative verify tick (docs/serving.md §Speculative
+        # decoding failure matrix).
+        self._pending_verify_faults: List[chaos.Fault] = []
+        # Speculative decoding (docs/serving.md §Speculative decoding):
+        # a host-side n-gram drafter proposes tokens the batched
+        # verify-<k> program checks; greedy accept keeps every output
+        # bitwise-oracle, so TDX_SPEC_DECODE=0 trades only throughput.
+        self._drafter: Optional[NgramDrafter] = (
+            NgramDrafter() if self.scfg.spec_decode else None)
+        self.spec_drafted = 0      # draft tokens sent to verify
+        self.spec_accepted = 0     # draft tokens accepted
+        self.spec_verify_ticks = 0  # batched verify calls
         self._programs: Dict[str, object] = {}
         self._spec_cache: Optional[Dict[str, object]] = None
         self.waiting: deque[Request] = deque()
@@ -224,6 +249,9 @@ class ServeEngine:
                     max_new_tokens=self.scfg.max_new_tokens,
                     prefill_chunk=self.scfg.prefill_chunk or None,
                     prefix_cache=self.scfg.prefix_cache,
+                    spec_buckets=self.scfg.spec_buckets,
+                    spec_decode=self.scfg.spec_decode,
+                    spec_k=self.scfg.spec_k,
                 ),
                 seed=self._seed, param_dtype=self._param_dtype,
                 mesh=self.mesh, plan=self.plan,
@@ -306,6 +334,12 @@ class ServeEngine:
         calls, like any server's response log)."""
         for r in requests:
             self.submit(r)
+        if (self._drafter is not None and not len(self._drafter)
+                and len(self.prefix)):
+            # A fresh drafter on a warm radix tree (e.g. spec toggled on
+            # a long-lived replica) seeds itself from the preambles the
+            # tree already proved hot.
+            self._drafter.warm_from_prefix(self.prefix)
         if self._t0 is None:
             self._t0 = time.perf_counter()
         start = self._step_no  # budget is per CALL; _step_no is lifetime
@@ -477,6 +511,11 @@ class ServeEngine:
                     # is never silently dropped).
                     chaos.execute(self._pending_chunk_faults.pop(0))
                 self._decode_step()
+                if self._pending_verify_faults:
+                    # Same never-dropped contract as chunk faults: a
+                    # verify fault due on a step with no verify tick
+                    # (spec off, no decodable lanes) fires anyway.
+                    chaos.execute(self._pending_verify_faults.pop(0))
             except self._retryable as e:
                 get_logger().warning(
                     "serve: step %d fault (%s: %s); requeueing %d active "
@@ -503,14 +542,19 @@ class ServeEngine:
         """The serve chaos site, taken by hand instead of through
         :func:`chaos.maybe_inject`: ``raise:chunk`` faults are DEFERRED
         to the next prefill-chunk boundary (the mid-chunked-prefill
-        fault docs/serving.md's failure matrix pins); everything else
-        executes immediately, exactly as maybe_inject would."""
+        fault docs/serving.md's failure matrix pins), ``raise:verify``
+        to the next speculative verify tick (mid-verify, after drafts
+        were taken and capacity extended — the worst rollback moment);
+        everything else executes immediately, exactly as maybe_inject
+        would."""
         plan = chaos.active_plan()
         if plan is None:
             return
         for fault in plan.take("serve", self._step_no):
             if fault.kind == "raise" and fault.arg == "chunk":
                 self._pending_chunk_faults.append(fault)
+            elif fault.kind == "raise" and fault.arg == "verify":
+                self._pending_verify_faults.append(fault)
             else:
                 chaos.execute(fault)
 
@@ -585,7 +629,13 @@ class ServeEngine:
         reqledger.on_admit(req.rid, replica=self.slo.name,
                            prefix_tokens=start)
         lane = _Lane(req=req, seq_id=sid, slot=slot, length=start,
-                     admitted_step=self._step_no, prefilling=True)
+                     admitted_step=self._step_no, prefilling=True,
+                     spec_k=self.scfg.spec_k)
+        if self._drafter is not None:
+            # The prompt's n-grams are the drafter's cheapest signal:
+            # shared preambles recur across requests, and tiny greedy
+            # models echo their prompts.
+            self._drafter.observe(req.tokens)
         try:
             with observe.span(
                 "serve.prefill", category="serve", rid=req.rid, tokens=L,
@@ -783,6 +833,12 @@ class ServeEngine:
     def _decode_step(self) -> None:
         if not self._decodable():
             return
+        if self._drafter is not None:
+            self._spec_decode_step()
+        else:
+            self._plain_decode_step()
+
+    def _plain_decode_step(self) -> None:
         self._ensure_capacity()
         slots = self._decodable()
         if not slots:
@@ -834,8 +890,185 @@ class ServeEngine:
             self._emit(lane, int(np.argmax(logits[slot])), logits[slot])
         observe.counter("tdx.serve.decode_steps").inc()
 
+    # -- speculative decode (docs/serving.md §Speculative decoding) ---------
+
+    def _drafts_for(self, slots: List[int]) -> Dict[int, List[int]]:
+        """Per-slot draft proposals, clamped so no draft can outrun the
+        request's token budget or the context cap (tokens verified past
+        either would be discarded — wasted verify width)."""
+        drafts: Dict[int, List[int]] = {}
+        for slot in slots:
+            lane = self.active[slot]
+            req = lane.req
+            k = min(
+                lane.spec_k,
+                req.max_new_tokens - len(lane.generated) - 1,
+                self.scfg.max_context - lane.length - 1,
+            )
+            if k <= 0:
+                drafts[slot] = []
+                continue
+            drafts[slot] = self._drafter.draft(
+                req.tokens + lane.generated, k)
+        return drafts
+
+    def _ensure_spec_capacity(self, drafts: Dict[int, List[int]]) -> None:
+        """Like :meth:`_ensure_capacity` but covering each lane's draft
+        window too.  Under pool pressure a lane's OWN draft is shed
+        before anyone gets preempted — speculation is optional, lanes
+        are not."""
+        for slot in sorted(self.active,
+                           key=lambda s: (self.active[s].admitted_step, s)):
+            lane = self.active.get(slot)
+            if lane is None or lane.prefilling:
+                continue
+            while True:
+                try:
+                    self.kv.extend(
+                        lane.seq_id,
+                        lane.length + len(drafts.get(slot, ())) + 1)
+                    break
+                except OutOfPages:
+                    if self.prefix.evict():
+                        continue
+                    if drafts.get(slot):
+                        drafts[slot] = []
+                        continue
+                    victim = max(
+                        self.active,
+                        key=lambda s: (self.active[s].admitted_step, s),
+                    )
+                    self._preempt(victim, reason="pages")
+                    if victim == slot:
+                        break  # this lane itself was the youngest
+
+    def _spec_decode_step(self) -> None:
+        """Draft → one batched verify tick → greedy accept + rollback.
+        The ``verify-<k>`` program scores all k+1 positions of every
+        lane in ONE call (a zero-draft lane occupies a width-1 ragged
+        row — exact decode semantics); greedy accept takes the longest
+        draft prefix matching the program's own argmaxes plus one
+        corrected (or bonus) token, then KV rollback retracts the
+        rejected positions — every emitted token is the token plain
+        decode would have produced, speculation only changes how many
+        arrive per tick."""
+        drafts = self._drafts_for(self._decodable())
+        if not any(drafts.values()) and not self._pending_verify_faults:
+            # Nothing proposed anywhere (cold drafter): plain decode is
+            # the same tick at width 1, without the rollback tax.
+            self._plain_decode_step()
+            return
+        self._ensure_spec_capacity(drafts)
+        # COW guard for the write at position ``length`` (no-op at
+        # refcount 1, like the chunk path) — BEFORE the page tables are
+        # snapshotted: a cow under pool pressure can preempt a lane, and
+        # a stale table row would let the verify tick scatter a dead
+        # lane's K/V into a freshly reused page.
+        for slot in self._decodable():
+            lane = self.active.get(slot)
+            if lane is not None:
+                self._cow_for(lane, lane.length // self.scfg.page_size)
+        slots = self._decodable()
+        if not slots:
+            return
+        if self._pending_verify_faults:
+            # The deferred ``raise:verify`` chaos fault: after drafting
+            # and capacity growth, before the verify call — the step
+            # fault handler must requeue lanes whose KV already covers
+            # speculative positions.
+            chaos.execute(self._pending_verify_faults.pop(0))
+        t_step = time.perf_counter()
+        B = self.scfg.max_batch
+        maxp = self.scfg.max_pages_per_seq
+        kb = self.scfg.spec_bucket_for(
+            max(len(drafts.get(s, ())) for s in slots) or 1)
+        tokens = np.zeros((B, kb + 1), np.int32)
+        start = np.zeros((B,), np.int32)
+        end = np.zeros((B,), np.int32)
+        table = np.zeros((B, maxp), np.int32)
+        table[slots] = self.kv.table_rows(
+            [self.active[s].seq_id for s in slots], maxp
+        )
+        for slot in slots:
+            lane = self.active[slot]
+            d = drafts.get(slot, ())
+            tokens[slot, 0] = (lane.generated[-1] if lane.generated
+                               else lane.req.tokens[-1])
+            if d:
+                tokens[slot, 1:1 + len(d)] = d
+            start[slot] = lane.length
+            end[slot] = lane.length + len(d) + 1
+        logits, self.k_pages, self.v_pages = self._program(f"verify-{kb}")(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(start), jnp.asarray(end),
+            jnp.asarray(table),
+        )
+        logits = np.asarray(logits)
+        dt = time.perf_counter() - t_step
+        n_lanes = len(slots)
+        ledger_on = reqledger.enabled()
+        total_emitted = 0
+        for slot in slots:
+            lane = self.active.get(slot)
+            if lane is None:  # pragma: no cover — nothing retires mid-loop
+                continue
+            d = drafts.get(slot, [])
+            rows = logits[slot]  # [kb+1, vocab]
+            accepted = 0
+            emitted: List[int] = []
+            for i, guess in enumerate(d):
+                t = int(np.argmax(rows[i]))
+                emitted.append(t)
+                if t != guess:
+                    break  # first wrong draft; t is the corrected token
+                accepted += 1
+            if accepted == len(d):
+                # Clean sweep: the last verified position yields one
+                # bonus token for free.
+                emitted.append(int(np.argmax(rows[len(d)])))
+            self.spec_drafted += len(d)
+            self.spec_accepted += accepted
+            if d:
+                # Per-lane k adaptation on the trailing outcome: grow
+                # back toward the configured cap on a clean sweep, back
+                # off when under half the draft survived.
+                if accepted == len(d):
+                    lane.spec_k = min(lane.spec_k + 1, self.scfg.spec_k)
+                elif accepted * 2 < len(d):
+                    lane.spec_k = max(1, lane.spec_k - 1)
+            if ledger_on:
+                reqledger.on_spec(lane.req.rid, drafted=len(d),
+                                  accepted=accepted, emitted=len(emitted),
+                                  n_lanes=n_lanes, replica=self.slo.name)
+            # Token-level rollback: the verify tick wrote K/V for every
+            # position in [length, length+len(d)]; positions past the
+            # accepted prefix hold rejected-draft state — retract them
+            # so the cache is bitwise what plain decode would have
+            # built before the next tick can read it.
+            self.kv.rollback(lane.seq_id, lane.length + accepted + 1)
+            for i, tok in enumerate(emitted):
+                lane.length += 1
+                self._emit(lane, tok, rows[i])
+                total_emitted += 1
+                if lane.slot not in self.active:
+                    break  # retired (eos / budget); KV already freed
+        self.spec_verify_ticks += 1
+        if total_emitted:
+            # Every token delivered this tick took the tick's wall time
+            # (they arrive together — that IS the speedup): one sample
+            # per token, the plain path's weighting contract.
+            self._tok_hist.observe(dt, n=total_emitted)
+            self.slo.observe_token_latency(dt, n=total_emitted)
+        observe.counter("tdx.serve.decode_steps").inc()
+
     def _emit(self, lane: _Lane, token: int, logits: np.ndarray) -> None:
         lane.generated.append(token)
+        if self._drafter is not None:
+            # One (order-gram -> token) pair per emitted token: the
+            # lane's own stream is the drafter's best predictor of the
+            # lane's future (greedy decode is deterministic).
+            seq = lane.req.tokens + lane.generated
+            self._drafter.observe(seq[-(self._drafter.order + 1):])
         # Recompute preemption replays a requeued request from scratch
         # (greedy decode regenerates the SAME prefix); positions the
         # client already received must not stream twice, and the
@@ -909,6 +1142,14 @@ class ServeEngine:
         observe.gauge("tdx.serve.prefix_nodes").set(self.prefix.page_count())
         observe.gauge("tdx.serve.prefix_hit_rate").set(
             round(self.prefix.hit_rate(), 4))
+        if self.spec_drafted:
+            # Speculative-decoding economics (docs/observability.md):
+            # drafted/accepted totals plus the realized accept rate —
+            # the fraction of proposed tokens the verify tick kept.
+            observe.gauge("tdx.serve.spec_drafted").set(self.spec_drafted)
+            observe.gauge("tdx.serve.spec_accepted").set(self.spec_accepted)
+            observe.gauge("tdx.serve.spec_accept_rate").set(
+                round(self.spec_accepted / self.spec_drafted, 4))
         if reqledger.enabled():
             reqledger.occupancy_sample(
                 replica=self.slo.name,

@@ -306,6 +306,34 @@ class PagedKVCache:
             self._update_gauges()
         return added
 
+    def rollback(self, seq_id: int, new_length: int) -> int:
+        """Token-level rollback (speculative decoding): shrink ``seq_id``
+        to ``new_length`` tokens, dropping THIS sequence's reference to
+        every trailing page the shorter length no longer needs.  Dropped
+        pages return to the free list at refcount zero; a trailing page
+        some other reader still holds (COW sharing) merely loses this
+        table's reference — the reader's contents are untouched.  The
+        partial tail page is truncated by bookkeeping alone: positions
+        past ``new_length`` are never attended (attention masks on
+        length) and are overwritten before they are ever valid again, so
+        after rollback the cache state is exactly what plain decode
+        would have produced.  Returns how many pages left this table.
+        The inverse edge of :meth:`extend`, which deliberately refuses
+        to shrink."""
+        seq = self._seqs[seq_id]
+        if not (0 <= new_length <= seq.length):
+            raise ValueError(
+                f"rollback target {new_length} outside [0, {seq.length}]"
+            )
+        keep = self.cfg.pages_for(new_length)
+        dropped = seq.pages[keep:]
+        del seq.pages[keep:]
+        seq.length = new_length
+        if dropped:
+            self.release(dropped)
+        self._update_gauges()
+        return len(dropped)
+
     def free(self, seq_id: int) -> int:
         """Retire a sequence, dropping one reference from each of its
         pages; pages whose refcount hits zero return to the free list

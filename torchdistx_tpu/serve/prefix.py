@@ -33,6 +33,15 @@ over preempting a running lane.
 fleet controller calls it across threads against a serving replica's
 live tree, so it mutates nothing and treats any concurrent-mutation
 artifact as "no match".
+
+:class:`NgramDrafter` is the speculative-decoding proposer that rides
+on top (docs/serving.md §Speculative decoding): a bounded host-side
+n-gram table fed by the same token streams the tree caches — admitted
+prompts, each lane's own emitted tokens, and :meth:`token_streams`
+warmup straight off the radix tree — proposing the k tokens most
+recently seen to follow the lane's current tail.  No second model, no
+weights; drafts are free guesses the batched ``verify-<k>`` program
+checks, so a wrong draft costs a verify slot and never a wrong token.
 """
 
 from __future__ import annotations
@@ -42,7 +51,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .kv_cache import PagedKVCache
 
-__all__ = ["PrefixCache"]
+__all__ = ["NgramDrafter", "PrefixCache"]
 
 
 @dataclass
@@ -96,6 +105,25 @@ class PrefixCache:
         ps = self.page_size
         return [tuple(tokens[i:i + ps])
                 for i in range(0, (len(tokens) // ps) * ps, ps)]
+
+    def token_streams(self) -> List[List[int]]:
+        """Every cached root→leaf prefix as one token stream (edge keys
+        concatenated in order) — the drafter-warmup view: a fresh
+        :class:`NgramDrafter` can absorb the preambles this tree already
+        proved hot without re-reading any request."""
+        out: List[List[int]] = []
+
+        def walk(node: _Node, acc: List[int]) -> None:
+            acc = acc + list(node.key)
+            if node.children:
+                for child in node.children.values():
+                    walk(child, acc)
+            else:
+                out.append(acc)
+
+        for n in self._children.values():
+            walk(n, [])
+        return out
 
     def match(self, tokens: Sequence[int]) -> List[int]:
         """The pages of the longest cached page-aligned prefix of
@@ -204,3 +232,74 @@ class PrefixCache:
         self._children = {}
         self._count = 0
         return len(pages)
+
+
+class NgramDrafter:
+    """Self-drafting n-gram proposer for speculative decoding.
+
+    A bounded map from each ``order``-token tail to the token most
+    recently observed to follow it, fed by :meth:`observe` on admitted
+    prompts and emitted tokens (last writer wins — recency is the whole
+    model).  :meth:`draft` walks the map up to ``k`` steps from a lane's
+    current tail and stops at the first unknown tail, so drafts are
+    always a contiguous guess at the sequential greedy chain.  Greedy
+    accept in the engine makes draft quality a pure throughput knob:
+    every proposed token is checked by the batched verify program, so
+    the drafter can be arbitrarily wrong without costing a token of
+    output (docs/serving.md §Speculative decoding).
+    """
+
+    def __init__(self, order: int = 2, max_entries: int = 1 << 16):
+        if order < 1:
+            raise ValueError(f"order must be >= 1, got {order}")
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.order = order
+        self.max_entries = max_entries
+        self._next: Dict[Tuple[int, ...], int] = {}
+        self.observed = 0   # (gram -> next) pairs absorbed
+        self.proposed = 0   # draft tokens handed out
+
+    def __len__(self) -> int:
+        return len(self._next)
+
+    def observe(self, tokens: Sequence[int]) -> int:
+        """Absorb every ``(order-gram -> next token)`` pair in
+        ``tokens``.  At capacity, known grams keep updating (recency)
+        and new grams are dropped — bounded memory beats completeness
+        for a proposer whose misses are free."""
+        o = self.order
+        seen = 0
+        nxt = self._next
+        toks = list(tokens)
+        for i in range(len(toks) - o):
+            key = tuple(toks[i:i + o])
+            if len(nxt) >= self.max_entries and key not in nxt:
+                continue
+            nxt[key] = toks[i + o]
+            seen += 1
+        self.observed += seen
+        return seen
+
+    def warm_from_prefix(self, prefix: PrefixCache) -> int:
+        """Seed the map from every prompt stream the radix tree holds —
+        replica warmup for the preambles that dominate traffic."""
+        return sum(self.observe(s) for s in prefix.token_streams())
+
+    def draft(self, context: Sequence[int], k: int) -> List[int]:
+        """Up to ``k`` proposed continuation tokens of ``context``
+        (possibly empty: an unknown tail proposes nothing, and the
+        engine's verify tick degenerates to plain decode)."""
+        o = self.order
+        if k <= 0 or len(context) < o:
+            return []
+        tail = list(context[-o:])
+        out: List[int] = []
+        for _ in range(k):
+            nxt = self._next.get(tuple(tail))
+            if nxt is None:
+                break
+            out.append(nxt)
+            tail = tail[1:] + [nxt]
+        self.proposed += len(out)
+        return out

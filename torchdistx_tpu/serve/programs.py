@@ -23,6 +23,12 @@ byte-identical programs:
   paged_prefill_attention`) — the program chunked prefill and
   prefix-reuse suffixes run, one per prefill bucket so chunk shapes
   bucket exactly like prompts do;
+* **verify-<k>** — the speculative-decoding verify tick: every lane
+  scores its last emitted token plus up to ``k`` drafted tokens in one
+  batched ragged pass against the paged cache (the batched sibling of
+  ``chunk-<bucket>``), returning logits for ALL ``k+1`` positions so
+  greedy accept can take the longest matching draft prefix plus one
+  corrected token (docs/serving.md §Speculative decoding);
 * **cow** — the copy-on-write page duplication: clone one pool page
   (all layers, K and V) into a fresh page before a grower writes into
   a shared one.
@@ -72,6 +78,7 @@ __all__ = [
     "build_cow_fn",
     "build_decode_fn",
     "build_prefill_fn",
+    "build_verify_fn",
     "compile_serving_program",
     "make_model",
     "model_family",
@@ -101,6 +108,15 @@ class ServeConfig:
     # Prefix-sharing toggle (serve/prefix.py).  Host-side too: both
     # bench arms run the same registry-warmed programs.
     prefix_cache: bool = True
+    # Speculative decoding (docs/serving.md §Speculative decoding).
+    # ``spec_buckets`` is the compiled verify-<k> program family — a
+    # SHAPE knob, like prefill_buckets.  ``spec_decode``/``spec_k`` are
+    # host-side scheduling knobs (None → TDX_SPEC_DECODE/TDX_SPEC_K):
+    # both bench arms, spec on and off, run the same registry-warmed
+    # program set.
+    spec_buckets: Tuple[int, ...] = ()       # default: (2, 4)
+    spec_decode: Optional[bool] = None
+    spec_k: Optional[int] = None
 
     def resolve(self, cfg: TransformerConfig) -> "ResolvedServeConfig":
         page = self.page_size
@@ -125,11 +141,26 @@ class ServeConfig:
         if chunk is None or chunk <= 0:
             chunk = buckets[-1]
         chunk = max(1, min(chunk, buckets[-1]))
+        spec_buckets = tuple(self.spec_buckets) or (2, 4)
+        # A verify-<k> tick writes k+1 positions; k must leave room for
+        # at least one prior context token.
+        spec_buckets = tuple(sorted(
+            {max(1, min(k, max_context - 2)) for k in spec_buckets}
+        ))
+        spec_on = self.spec_decode
+        if spec_on is None:
+            spec_on = tdx_config.get().spec_decode
+        spec_k = self.spec_k
+        if spec_k is None:
+            spec_k = tdx_config.get().spec_k
+        spec_k = max(1, min(spec_k, spec_buckets[-1]))
         return ResolvedServeConfig(
             max_batch=self.max_batch, page_size=page, n_pages=self.n_pages,
             max_pages_per_seq=maxp, prefill_buckets=buckets,
             max_new_tokens=self.max_new_tokens, max_context=max_context,
             prefill_chunk=chunk, prefix_cache=self.prefix_cache,
+            spec_buckets=spec_buckets, spec_decode=bool(spec_on),
+            spec_k=spec_k,
         )
 
 
@@ -147,6 +178,9 @@ class ResolvedServeConfig:
     max_context: int
     prefill_chunk: int = 0      # resolved chunk cap (host-side knob)
     prefix_cache: bool = True   # prefix sharing armed (host-side knob)
+    spec_buckets: Tuple[int, ...] = (2, 4)  # compiled verify-<k> family
+    spec_decode: bool = True    # speculation armed (host-side knob)
+    spec_k: int = 4             # max draft length (host-side knob)
 
     def kv_config(self, cfg: TransformerConfig) -> KVCacheConfig:
         return KVCacheConfig(
@@ -163,6 +197,15 @@ class ResolvedServeConfig:
             f"prompt of {n_tokens} tokens exceeds the largest prefill "
             f"bucket {self.prefill_buckets[-1]} (max_context="
             f"{self.max_context})"
+        )
+
+    def spec_bucket_for(self, n_draft: int) -> int:
+        for k in self.spec_buckets:
+            if k >= n_draft:
+                return k
+        raise ValueError(
+            f"draft of {n_draft} tokens exceeds the largest verify "
+            f"bucket {self.spec_buckets[-1]}"
         )
 
 
@@ -428,6 +471,48 @@ def build_chunk_prefill_fn(family: str, cfg: TransformerConfig,
     return chunk_fn
 
 
+def build_verify_fn(family: str, cfg: TransformerConfig,
+                    scfg: ResolvedServeConfig, k: int) -> Callable:
+    """The batched speculative-verify program for one draft bucket:
+    ``(params, k_pages, v_pages, tokens [B, k+1], start [B], end [B],
+    page_table [B, maxp]) -> (logits [B, k+1, vocab], k_pages,
+    v_pages)``.  Lane ``b`` feeds its last emitted token plus its draft,
+    left-aligned in ``tokens[b]``, occupying absolute positions
+    ``[start[b], end[b])`` (``end - start`` = 1 + draft length, ≤ k+1);
+    padded positions past ``end`` write the null page and are masked out
+    of attention, and idle lanes carry ``start == end == 0`` with a null
+    table row.  Row ``i`` of the logits scores the token AFTER position
+    ``start + i``, so greedy accept walks the rows left to right: accept
+    while the draft token equals the row's argmax, then emit one
+    corrected (or bonus) token — exactly the sequential greedy chain,
+    which is what keeps speculation bitwise-equal to the oracle.  The
+    batched sibling of :func:`build_chunk_prefill_fn`: same
+    ``_chunk_block`` scatter-and-ragged-attend per layer, but every lane
+    at once and the head applied to every position instead of the last."""
+    decomp = make_model(family, cfg).decode_decomposition()
+
+    def verify_fn(params, k_pages, v_pages, tokens, start, end, page_table):
+        p = params["params"]
+        S = tokens.shape[1]  # k + 1
+        positions = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+        x = decomp.embed(p, tokens, positions)
+        angles = decomp.angles_at(positions)
+
+        def step(blk, x, kp, vp):
+            return _chunk_block(
+                cfg, blk, x, kp, vp, angles=angles, positions=positions,
+                end=end, page_table=page_table,
+            )
+
+        x, k_pages, v_pages = _scan_blocks(
+            decomp, p, x, k_pages, v_pages, step
+        )
+        logits = decomp.head(p, x)  # [B, k+1, vocab]
+        return logits, k_pages, v_pages
+
+    return verify_fn
+
+
 def build_cow_fn() -> Callable:
     """The copy-on-write page duplication program:
     ``(k_pages, v_pages, src [1], dst [1]) -> (k_pages, v_pages)`` —
@@ -652,6 +737,23 @@ def serve_program_specs(
         program_fp=_fp("decode", family, cfg, scfg, extra),
         init_options=False,
     ))
+    # The verify-<k> family is part of every replica shape's program set
+    # REGARDLESS of the spec_decode host knob: warm once, then flip
+    # speculation on or off without invalidating a byte of the registry
+    # (the fingerprint-host-knob invariance test pins this).
+    for k in scfg.spec_buckets:
+        specs.append(ServeProgramSpec(
+            name=f"verify-{k}",
+            fn=build_verify_fn(family, cfg, scfg, k),
+            args=(params_abs, pool_sds, pool_sds,
+                  jax.ShapeDtypeStruct((B, k + 1), i32),
+                  jax.ShapeDtypeStruct((B,), i32),
+                  jax.ShapeDtypeStruct((B,), i32),
+                  jax.ShapeDtypeStruct((B, maxp), i32)),
+            out_shardings=None,
+            program_fp=_fp(f"verify-{k}", family, cfg, scfg, extra),
+            init_options=False,
+        ))
     return specs
 
 
