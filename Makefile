@@ -2,7 +2,7 @@
 # torchdistx_tpu/_lib/ (used automatically when present; TDX_NATIVE=0
 # disables).
 
-.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke guardrails-smoke soak-smoke bench-smoke bench-trend lint lint-native trace-summary wheel packaging-smoke docs examples clean
+.PHONY: native native-test native-test-build native-cmake leak-check test chaos-test registry-smoke serve-smoke fleet-smoke obs-smoke reshard-smoke guardrails-smoke rollover-smoke soak-smoke bench-smoke bench-trend lint lint-native trace-summary wheel packaging-smoke docs examples clean
 
 NATIVE_CXXFLAGS := -std=c++17 -O2 -fPIC -fvisibility=hidden \
 	-Wall -Wextra -fstack-protector-strong
@@ -50,11 +50,11 @@ test:
 # subprocesses).  JAX_PLATFORMS=cpu: chaos scenarios are deterministic
 # CPU reproductions; real-hardware recovery is soaked separately via
 # `tools/soak.py --modes elastic` under tools/tpu_watch.py windows.
-chaos-test: registry-smoke serve-smoke fleet-smoke guardrails-smoke obs-smoke reshard-smoke
+chaos-test: registry-smoke serve-smoke fleet-smoke guardrails-smoke rollover-smoke obs-smoke reshard-smoke
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
 	    tests/test_materialize_chaos.py tests/test_failures.py \
 	    tests/test_registry.py tests/test_serve.py tests/test_fleet.py \
-	    tests/test_guardrails.py \
+	    tests/test_guardrails.py tests/test_rollover.py \
 	    tests/test_flightrec.py tests/test_materialize_transport.py \
 	    tests/test_live_ops.py tests/test_bench_trend.py \
 	    tests/test_reshard.py \
@@ -95,6 +95,17 @@ fleet-smoke:
 # part of `make chaos-test`.
 guardrails-smoke:
 	timeout -k 10 420 bash scripts/guardrails_smoke.sh
+
+# Rollover smoke (docs/serving.md §Weight rollover): run_elastic trains
+# two committed checkpoints, then a registry-warm 2-replica fleet rolls
+# blue-green onto step_2 MID-STORM — GREEN bring-up with zero local
+# compiles, bitwise canary gate, shift, BLUE drains — every response
+# oracle-equal for the version it was served under, zero rejections;
+# then a bit-flipped step_2 is caught by the gate's verify arm,
+# quarantined, with BLUE serving untouched.  CPU, bounded; part of
+# `make chaos-test`.
+rollover-smoke:
+	timeout -k 10 420 bash scripts/rollover_smoke.sh
 
 # Pod-scale registry smoke (docs/registry.md): a 2-process sharded warm
 # against a shared artifact registry — disjoint compile shards verified

@@ -1,0 +1,145 @@
+#!/usr/bin/env bash
+# Rollover smoke (make rollover-smoke, docs/serving.md §Weight rollover):
+# warm a replica shape's serving program set into a shared artifact
+# registry, train two committed checkpoints with run_elastic, then in a
+# FRESH process with an EMPTY local TDX_CACHE_DIR bring up a 2-replica
+# fleet on step_1 and blue-green roll it onto step_2 WHILE a request
+# storm runs: GREEN comes up registry-warm (ZERO local compiles), the
+# bitwise canary gate passes, traffic shifts, every BLUE drains, and
+# every storm response is bitwise-equal to the oracle FOR THE WEIGHT
+# VERSION IT WAS SERVED UNDER with zero typed rejections and no KV page
+# leaked.  A second, negative pass rolls onto a bit-flipped copy of
+# step_2: the gate's verify arm catches it at fetch, the roll aborts,
+# the bad checkpoint is quarantined (renamed *.corrupt), and BLUE keeps
+# serving oracle-exact throughout.  CPU-only, bounded; the in-process
+# equivalents live in tests/test_rollover.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TDX_CACHE_MIN_COMPILE_S=0
+
+TMP=$(mktemp -d /tmp/tdx_rollover_smoke.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+REG="$TMP/registry"
+
+echo "== decode-program warm: init + prefill buckets + decode published =="
+python tools/warm_cache.py --decode --model tiny --cache-dir "$TMP/warm" \
+    --registry-dir "$REG" --serve-batch 2 --page-size 8 --pages 32 \
+    --max-pages-per-seq 4 --prefill-buckets 8,16 \
+    > "$TMP/warm.json" 2> "$TMP/warm.log"
+grep '^warm:' "$TMP/warm.log" | sed 's/^/  /'
+
+echo "== fresh-process fleet: mid-storm roll step_1 -> step_2 =="
+TDX_CACHE_DIR="$TMP/fresh" TDX_REGISTRY_DIR="$REG" TMPDIR="$TMP" \
+    python - <<'EOF'
+import os
+import shutil
+import time
+
+import jax
+import numpy as np
+
+from torchdistx_tpu import chaos, observe
+from torchdistx_tpu.serve import (
+    FleetConfig, Request, ServeConfig, ServeFleet, oracle_generate,
+)
+from torchdistx_tpu.utils.failures import run_elastic
+
+observe.enable(True)
+
+
+def csnap():
+    return {r["name"]: r["value"] for r in observe.counters().snapshot()
+            if r["type"] == "counter"}
+
+
+scfg = ServeConfig(max_batch=2, page_size=8, n_pages=32,
+                   max_pages_per_seq=4, prefill_buckets=(8, 16))
+fl = ServeFleet("tiny", serve_cfg=scfg,
+                fleet_cfg=FleetConfig(min_replicas=2, max_replicas=4,
+                                      autoscale=False, stall_s=60.0))
+fl.start(2, timeout=240.0)
+snap = csnap()
+assert snap.get("tdx.jax.compile_cache_miss", 0) == 0, (
+    f"bring-up paid local compiles: "
+    f"{[h.engine.bring_up_outcomes for h in fl.handles]}")
+print("  bring-up: 2 replicas warm, 0 local compiles")
+
+# "Training": two elastic steps over the serving pytree, checkpointed
+# every step — step_1 matches what the fleet serves, step_2 is N+1.
+ckpt_dir = os.path.join(os.environ["TMPDIR"], "ckpts")
+run_elastic(lambda s, b: (jax.tree.map(lambda x: x * 0.999, s), {}),
+            fl.params, range(2), checkpoint_dir=ckpt_dir,
+            checkpoint_every=1)
+step2 = os.path.join(ckpt_dir, "step_2")
+assert os.path.isdir(step2), os.listdir(ckpt_dir)
+# The negative pass below needs its own (soon to be bit-flipped) copy.
+step2_bad = os.path.join(ckpt_dir, "step_2_bad")
+shutil.copytree(step2, step2_bad)
+print("  run_elastic: committed step_1 + step_2")
+
+rng = np.random.RandomState(31)
+reqs = [Request(f"r{i}",
+                [int(t) for t in rng.randint(0, 256,
+                                             size=1 + int(rng.randint(10)))],
+                max_new_tokens=4 + int(rng.randint(8)), arrival_step=i)
+        for i in range(20)]
+ctl = fl.start_rollover(step2)
+out = fl.run(reqs, max_seconds=240.0)
+deadline = time.monotonic() + 120.0
+while ctl.outcome is None:
+    assert time.monotonic() < deadline, f"roll stuck at {ctl.stage}"
+    fl.tick()
+    time.sleep(0.002)
+assert ctl.outcome == "completed", (ctl.outcome, ctl.stage, ctl.error)
+assert not fl.rejected, fl.rejected
+for r in reqs:
+    v = fl.served_version[r.rid]
+    want, _ = oracle_generate(fl.family, fl.cfg, fl.version_params[v],
+                              r.tokens, r.max_new_tokens)
+    assert out[r.rid] == want, (r.rid, v, out[r.rid], want)
+assert all(h.weight_version == ctl.version for h in fl.handles), (
+    [(h.idx, h.weight_version) for h in fl.handles])
+for h in fl.handles:
+    if h.engine is not None and h.engine.k_pages is not None:
+        assert h.engine.kv.pages_in_use == h.engine.prefix.page_count(), (
+            h.idx, h.engine.kv.pages_in_use)
+snap = csnap()
+assert snap.get("tdx.jax.compile_cache_miss", 0) == 0, (
+    "GREEN bring-up paid a local compile")
+assert snap.get("tdx.fleet.rollover_completed", 0) == 1, snap
+print(f"  OK: rolled to {ctl.version} mid-storm — 20/20 responses == "
+      f"per-version oracle, 0 rejections, 0 local compiles "
+      f"({int(snap.get('tdx.fleet.rollover_blue_drains', 0))} BLUE drains)")
+
+# Negative pass: a bit-flipped step_2 must be caught by the gate's
+# verify arm, quarantined, and BLUE must keep serving untouched.
+chaos.corrupt_checkpoint(step2_bad, mode="flip")
+ctl2 = fl.start_rollover(step2_bad)
+reqs2 = [Request(f"b{i}", [7 + i, 3, 1], max_new_tokens=4, arrival_step=i)
+         for i in range(6)]
+out2 = fl.run(reqs2, max_seconds=240.0)
+deadline = time.monotonic() + 60.0
+while ctl2.outcome is None:
+    assert time.monotonic() < deadline, f"abort stuck at {ctl2.stage}"
+    fl.tick()
+    time.sleep(0.002)
+assert ctl2.outcome == "aborted", (ctl2.outcome, ctl2.stage)
+assert ctl2.quarantined and not os.path.exists(step2_bad), ctl2.digest()
+assert os.path.exists(step2_bad + ".corrupt")
+assert not fl.rejected, fl.rejected
+for r in reqs2:
+    v = fl.served_version[r.rid]
+    assert v == ctl.version, (r.rid, v)  # BLUE-of-this-roll == step_2
+    want, _ = oracle_generate(fl.family, fl.cfg, fl.version_params[v],
+                              r.tokens, r.max_new_tokens)
+    assert out2[r.rid] == want, (r.rid, out2[r.rid], want)
+snap = csnap()
+assert snap.get("tdx.fleet.rollover_aborts", 0) == 1, snap
+fl.shutdown()
+print(f"  OK: bit-flipped step_2 caught at {ctl2.failed_stage}, "
+      f"quarantined to *.corrupt, fleet kept serving oracle-exact")
+EOF
+
+echo "rollover-smoke OK"

@@ -131,6 +131,14 @@ _HOST_PHASES = {
         "ledger_p99_blame_decode": 0.46, "ledger_p99_blame_guardrail": 0.02,
         "ledger_e2e_p99_s": 0.021, "oracle_equal": True,
         "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
+    "serving_rollover": {
+        "storm_requests": 24, "steady_tokens_per_s": 612.0,
+        "rollover_tokens_per_s": 588.0,
+        "rollover_tokens_per_s_ratio": 0.961,
+        "steady_p95_ttft_s": 0.031, "rollover_p95_ttft_s": 0.042,
+        "rollover_roll_s": 9.4, "rollover_blue_drains": 2,
+        "warm_local_compiles": 0, "oracle_equal": True,
+        "host_cpu_count": 1, "backend": "cpu", "_backend": "cpu"},
     "guardrails": {
         "storm_requests": 48, "bring_up_cold_s": 4.2,
         "guardrails_breaker_trips": 1, "guardrails_hedged": 0,
@@ -214,6 +222,8 @@ def test_healthy_branch_headline_and_detail(bench):
     assert full["serving_prefix"]["prefix_hits"] == 38
     assert headline["ledger_overhead_ratio"] == 0.994
     assert full["serving_ledger"]["ledger_p99_blame_queue"] == 0.44
+    assert headline["rollover_tokens_per_s_ratio"] == 0.961
+    assert full["serving_rollover"]["rollover_blue_drains"] == 2
     assert full["reshard_bytes_moved"] == 134217728
     assert full["materialize_pipeline"]["bitwise_equal"] is True
     assert full["schedule_measured"]["interleaved_vs_flat_measured"] == 1.208

@@ -178,6 +178,24 @@ def test_quarantine_backoff_doubles_and_caps():
     assert q.backoff_s == 6.0
 
 
+def test_quarantine_backoff_explicit_cap():
+    """``backoff_cap_s`` pins the doubling ceiling independently of the
+    quarantine residency bound: the backoff clamps at the cap while
+    ``quarantine_max_s`` stays free to bound how long an entry may sit
+    quarantined overall."""
+    gc = GuardrailConfig(quarantine_s=2.0, quarantine_max_s=60.0,
+                         backoff_cap_s=5.0)
+    q = QuarantineEntry(origin_idx=1, until=2.0, backoff_s=2.0, probe_idx=3)
+    q.fail_probe(10.0, gc)
+    assert (q.backoff_s, q.probe_idx) == (4.0, None)
+    q.fail_probe(20.0, gc)
+    assert q.backoff_s == 5.0  # capped by backoff_cap_s, not 60s
+    q.fail_probe(30.0, gc)
+    assert (q.backoff_s, q.until) == (5.0, 35.0)
+    with pytest.raises(ValueError):
+        GuardrailConfig(backoff_cap_s=0.0)
+
+
 def test_brownout_hysteresis():
     gc = GuardrailConfig(brownout_queue_per_replica=4.0,
                          brownout_enter_consecutive=2,

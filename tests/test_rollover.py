@@ -1,0 +1,476 @@
+"""Blue-green weight-rollover tests (ISSUE 20 tentpole): a live fleet
+rolls from step N to N+1 behind a bitwise canary gate — GREEN spins up
+registry-warm on the new weights, must reproduce the NEW oracle on a
+probe set before taking traffic, then BLUEs drain one at a time so
+capacity never dips below the floor and no in-flight request migrates
+across versions mid-decode.  Failure containment is degrade-never-
+corrupt: canary mismatch, GREEN death, or injected ``rollover``-site
+chaos aborts the roll, quarantines the checkpoint, and leaves BLUE's
+output stream untouched.  The storm invariant extends the fleet oracle
+gate per-version: every completed request is bitwise-equal to
+``oracle_generate`` under THE WEIGHTS IT WAS SERVED UNDER; every other
+one carries exactly one typed rejection whose delivered tokens are an
+oracle prefix of its served version; no KV page leaks."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchdistx_tpu.config as tdx_config
+from torchdistx_tpu import chaos, observe
+from torchdistx_tpu.models import TransformerConfig
+from torchdistx_tpu.serve import (
+    FleetConfig,
+    Request,
+    RollError,
+    RolloverConfig,
+    ServeConfig,
+    ServeFleet,
+    oracle_generate,
+)
+from torchdistx_tpu.serve import rollover as rollover_mod
+from torchdistx_tpu.serve.router import REJECT_REASONS
+from torchdistx_tpu.utils.checkpoint import (
+    QUARANTINE_SUFFIX,
+    checkpoint_version,
+    save_checkpoint,
+)
+
+LLAMA = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32,
+)
+SCFG = ServeConfig(max_batch=2, page_size=8, n_pages=16,
+                   max_pages_per_seq=3, prefill_buckets=(8, 16))
+
+
+@pytest.fixture(scope="module")
+def shared_cache(tmp_path_factory):
+    """One persistent compile cache for every fleet in this module (same
+    rationale as tests/test_fleet.py: measure roll behavior, not compile
+    time)."""
+    d = str(tmp_path_factory.mktemp("rollover_cache"))
+    old = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ["TDX_CACHE_MIN_COMPILE_S"] = "0"
+    yield d
+    if old is None:
+        os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+    else:
+        os.environ["TDX_CACHE_MIN_COMPILE_S"] = old
+
+
+@pytest.fixture(autouse=True)
+def _map_headroom():
+    """By the time this module runs, a full-suite process sits just
+    under ``vm.max_map_count`` (~65k mappings of accumulated jitted
+    executables) and XLA:CPU segfaults when mmap starts failing — the
+    same ceiling bench.py's fleet phases clear between stages.  Each
+    roll test compiles its own program wave, so drop the global
+    executable cache on entry (the module's TDX disk cache keeps the
+    recompiles cheap); every module after this one inherits the
+    headroom."""
+    jax.clear_caches()
+    yield
+
+
+def _fleet(**fc_kw):
+    fc_kw.setdefault("stall_s", 60.0)
+    fc_kw.setdefault("autoscale", False)
+    return ServeFleet(LLAMA, family="llama", serve_cfg=SCFG,
+                      fleet_cfg=FleetConfig(**fc_kw))
+
+
+def _csnap():
+    out = {}
+    for r in observe.counters().snapshot():
+        if r["type"] == "counter":
+            # Sum across label sets (tdx.chaos.injected{kind=...}).
+            out[r["name"]] = out.get(r["name"], 0.0) + r["value"]
+    return out
+
+
+def _save_next(fl, tmp_path, *, scale=1.05, name="step_2"):
+    """Commit a next-step checkpoint: the serving pytree, perturbed —
+    numerically distinct weights whose oracle differs from BLUE's."""
+    new_params = jax.tree.map(lambda x: x * scale, fl.params)
+    path = str(tmp_path / name)
+    save_checkpoint(path, new_params)
+    return path
+
+
+def _drive(fl, ctl, reqs, *, timeout=240.0, floor=None):
+    """Submit ``reqs`` and tick until the storm AND the roll are done;
+    returns the min serving-replica count observed (floor check)."""
+    for r in reqs:
+        fl.submit(r)
+    deadline = time.monotonic() + timeout
+    min_serving = len(fl.handles)
+    while fl._pending or ctl.outcome is None:
+        fl.tick()
+        n = sum(1 for h in fl.handles if h.state == "serving")
+        min_serving = min(min_serving, n)
+        if floor is not None:
+            assert n >= floor, (
+                f"serving capacity dipped to {n} < floor {floor} at "
+                f"stage {ctl.stage}")
+        assert time.monotonic() < deadline, (
+            ctl.stage, ctl.outcome, len(fl._pending),
+            [(h.idx, h.state, h.weight_version) for h in fl.handles])
+        time.sleep(0.001)
+    return min_serving
+
+
+def _check_versioned_oracle(fl, reqs):
+    """The per-version storm invariant: completion ⇒ bitwise-equal to
+    the oracle under the weights that served it; rejection ⇒ exactly
+    one, typed, with delivered tokens an oracle prefix of its served
+    version."""
+    for r in reqs:
+        if r.rid in fl.results:
+            assert r.rid not in fl.rejected, r.rid
+            v = fl.served_version[r.rid]
+            params = fl.version_params[v]
+            want, want_logits = oracle_generate(
+                fl.family, fl.cfg, params, r.tokens, r.max_new_tokens,
+                r.eos_id)
+            assert fl.results[r.rid] == want, (r.rid, v)
+            np.testing.assert_allclose(
+                fl.final_logits[r.rid], want_logits, atol=1e-4,
+                err_msg=f"final logits of {r.rid} under {v}")
+        else:
+            rej = fl.rejected[r.rid]  # exactly one, typed
+            assert rej.reason in REJECT_REASONS, rej
+            if rej.tokens:
+                v = fl.served_version.get(r.rid)
+                want, _ = oracle_generate(
+                    fl.family, fl.cfg, fl.version_params[v], r.tokens,
+                    r.max_new_tokens, r.eos_id)
+                assert list(rej.tokens) == want[:len(rej.tokens)], (
+                    r.rid, v, rej)
+
+
+def _check_kv_clean(fl):
+    for h in fl.handles:
+        if h.engine is not None and h.engine.k_pages is not None:
+            assert h.engine.kv.pages_in_use == h.engine.prefix.page_count(), (
+                h.idx, h.engine.kv.pages_in_use,
+                h.engine.prefix.page_count())
+
+
+def _storm(tag, n=14, new_tokens=6):
+    rng = np.random.RandomState(13)
+    return [
+        Request(f"{tag}{i}",
+                [int(t) for t in rng.randint(0, 128,
+                                             size=1 + int(rng.randint(8)))],
+                max_new_tokens=2 + int(rng.randint(new_tokens)),
+                arrival_step=i)
+        for i in range(n)
+    ]
+
+
+# -- the happy path -----------------------------------------------------------
+
+
+def test_rollover_mid_storm_completes(shared_cache, tmp_path):
+    """A full blue-green roll races a live storm: fetch → canary (gate
+    passes against the NEW oracle) → shift → drain, capacity never
+    below the floor, every response bitwise-equal to the oracle of the
+    version it was served under, zero rejections, no KV page leaked,
+    and the fleet ends with every replica on the new stamp — visible
+    on /readyz per-replica rows."""
+    observe.enable(True)
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            with _fleet(min_replicas=2, max_replicas=4) as fl:
+                fl.start(2, timeout=240.0)
+                base = _csnap()
+                ckpt = _save_next(fl, tmp_path)
+                ctl = fl.start_rollover(ckpt)
+                assert fl.rollover is ctl and ctl.stage in ("fetch",
+                                                            "canary")
+                reqs = _storm("r")
+                _drive(fl, ctl, reqs, floor=2)
+                assert ctl.outcome == "completed", (ctl.stage, ctl.error)
+                assert ctl.version == checkpoint_version(ckpt)
+                assert fl.rollover is None
+                assert not fl.rejected, fl.rejected
+                assert set(fl.results) >= {r.rid for r in reqs}
+                _check_versioned_oracle(fl, reqs)
+                _check_kv_clean(fl)
+                # Every survivor serves the new stamp; both old BLUEs
+                # drained through the normal path.
+                assert all(h.weight_version == ctl.version
+                           for h in fl.handles)
+                assert fl.active_version == ctl.version
+                snap = _csnap()
+                assert snap.get("tdx.fleet.rollover_completed", 0) - \
+                    base.get("tdx.fleet.rollover_completed", 0) == 1
+                assert snap.get("tdx.fleet.rollover_blue_drains", 0) - \
+                    base.get("tdx.fleet.rollover_blue_drains", 0) == 2
+                # Probe internals never leak into client-visible state.
+                assert not any(r.startswith("~rollover")
+                               for r in list(fl.results) + list(fl.rejected))
+                # /readyz per-replica rows carry the weight version.
+                ready, detail = observe.health.readiness()
+                assert ready
+                rows = detail["fleet"]["replicas"]
+                assert {info.get("version") for info in rows.values()} == {
+                    ctl.version}
+                # A second roll may start once the first released the
+                # fleet (the one-roll-at-a-time guard).
+                with pytest.raises(RuntimeError, match="before rolling"):
+                    ServeFleet(LLAMA, family="llama",
+                               serve_cfg=SCFG).start_rollover(ckpt)
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+
+def test_rollover_only_one_in_flight(shared_cache, tmp_path):
+    with tdx_config.override(cache_dir=shared_cache):
+        with _fleet(min_replicas=1, max_replicas=2) as fl:
+            fl.start(1, timeout=240.0)
+            ckpt = _save_next(fl, tmp_path)
+            ctl = fl.start_rollover(ckpt)
+            with pytest.raises(RuntimeError, match="already in flight"):
+                fl.start_rollover(ckpt)
+            deadline = time.monotonic() + 240.0
+            while ctl.outcome is None:
+                assert time.monotonic() < deadline, ctl.stage
+                fl.tick()
+                time.sleep(0.001)
+            assert ctl.outcome == "completed", (ctl.stage, ctl.error)
+
+
+# -- chaos: storm invariant under faults + kills ------------------------------
+
+
+def test_storm_invariant_replica_kill_during_roll(shared_cache, tmp_path):
+    """The pinned chaos invariant: during a roll under load, with a
+    BLUE replica killed mid-batch (``fleet@1=raise``), every request
+    either completes bitwise-equal to the oracle FOR THE VERSION IT WAS
+    ADMITTED UNDER or gets exactly one typed rejection (a request whose
+    pinned version fully retired gets ``stale_version`` carrying an
+    oracle-prefix of delivered tokens) — and no KV page leaks."""
+    observe.enable(True)
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            with _fleet(min_replicas=2, max_replicas=4) as fl:
+                fl.start(2, timeout=240.0)
+                ckpt = _save_next(fl, tmp_path)
+                ctl = fl.start_rollover(ckpt)
+                reqs = _storm("k", n=16)
+                chaos.install("fleet@1=raise")
+                try:
+                    _drive(fl, ctl, reqs)
+                finally:
+                    chaos.clear()
+                assert ctl.outcome == "completed", (ctl.stage, ctl.error)
+                # Terminal exactly-once: results and rejections are
+                # disjoint and cover the storm.
+                done = {r.rid for r in reqs if r.rid in fl.results}
+                rej = {r.rid for r in reqs if r.rid in fl.rejected}
+                assert not (done & rej)
+                assert done | rej == {r.rid for r in reqs}
+                for rid in rej:
+                    assert fl.rejected[rid].reason in REJECT_REASONS
+                _check_versioned_oracle(fl, reqs)
+                _check_kv_clean(fl)
+                assert not fl.partial  # no torn streams left behind
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+
+def test_green_preempt_chaos_aborts_roll(shared_cache, tmp_path):
+    """``rollover@2=preempt`` kills only the GREEN canary: the roll
+    aborts as a green fault, the checkpoint is quarantined (unproven
+    weights), and BLUE's storm completes oracle-exact throughout."""
+    observe.enable(True)
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            with _fleet(min_replicas=2, max_replicas=4) as fl:
+                fl.start(2, timeout=240.0)
+                base = _csnap()
+                blues = list(fl.handles)
+                ckpt = _save_next(fl, tmp_path)
+                chaos.install("rollover@2=preempt")
+                try:
+                    ctl = fl.start_rollover(ckpt)
+                    reqs = _storm("p", n=10)
+                    _drive(fl, ctl, reqs)
+                finally:
+                    chaos.clear()
+                assert ctl.outcome == "aborted"
+                assert ctl.failed_stage == "canary"
+                assert isinstance(ctl.error, RollError)
+                assert "died" in str(ctl.error)
+                assert ctl.quarantined
+                assert not os.path.exists(ckpt)
+                assert os.path.exists(ckpt + QUARANTINE_SUFFIX)
+                # BLUE untouched: same two replicas, old weights, every
+                # response oracle-exact against the OLD params.
+                assert fl.handles == blues
+                assert fl.active_version is None
+                assert not fl.rejected
+                _check_versioned_oracle(fl, reqs)
+                _check_kv_clean(fl)
+                snap = _csnap()
+                assert snap.get("tdx.fleet.rollover_aborts", 0) - \
+                    base.get("tdx.fleet.rollover_aborts", 0) == 1
+                assert snap.get("tdx.chaos.injected", 0) - \
+                    base.get("tdx.chaos.injected", 0) >= 1
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+
+def test_fetch_corrupt_chaos_caught_by_verify(shared_cache, tmp_path):
+    """``rollover@1=corrupt:flip`` bit-flips the INCOMING checkpoint at
+    the fetch stage: the gate's verify arm catches it before a byte is
+    deserialized, the roll aborts, the damaged checkpoint is
+    quarantined, and no GREEN replica ever spawns."""
+    observe.enable(True)
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            with _fleet(min_replicas=1, max_replicas=2) as fl:
+                fl.start(1, timeout=240.0)
+                n_handles = len(fl.handles)
+                ckpt = _save_next(fl, tmp_path)
+                chaos.install("rollover@1=corrupt:flip")
+                try:
+                    ctl = fl.start_rollover(ckpt)
+                    deadline = time.monotonic() + 240.0
+                    while ctl.outcome is None:
+                        assert time.monotonic() < deadline, ctl.stage
+                        fl.tick()
+                        time.sleep(0.001)
+                finally:
+                    chaos.clear()
+                assert ctl.outcome == "aborted"
+                assert ctl.failed_stage == "fetch"
+                assert "verification" in str(ctl.error)
+                assert ctl.quarantined
+                assert os.path.exists(ckpt + QUARANTINE_SUFFIX)
+                assert ctl.green is None and len(fl.handles) == n_handles
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+
+# -- the canary gate ----------------------------------------------------------
+
+
+def test_canary_mismatch_aborts_quarantines_blue_unharmed(
+        shared_cache, tmp_path, monkeypatch):
+    """A GREEN replica that cannot reproduce the NEW oracle must never
+    take traffic: the gate fails closed — abort, quarantine, BLUE's
+    in-flight stream uninterrupted and bitwise-exact to the OLD
+    weights.  The mismatch is forced deterministically by feeding the
+    gate a poisoned oracle."""
+    real_oracle = rollover_mod.oracle_generate
+
+    def poisoned(family, cfg, params, prompt, max_new_tokens, eos_id=None):
+        toks, logits = real_oracle(family, cfg, params, prompt,
+                                   max_new_tokens, eos_id)
+        return [t + 1 for t in toks], logits  # GREEN can never match
+
+    monkeypatch.setattr(rollover_mod, "oracle_generate", poisoned)
+    observe.enable(True)
+    try:
+        with tdx_config.override(cache_dir=shared_cache):
+            with _fleet(min_replicas=2, max_replicas=4) as fl:
+                fl.start(2, timeout=240.0)
+                base = _csnap()
+                blues = list(fl.handles)
+                ckpt = _save_next(fl, tmp_path)
+                ctl = fl.start_rollover(ckpt)
+                reqs = _storm("m", n=10)
+                _drive(fl, ctl, reqs)
+                assert ctl.outcome == "aborted"
+                assert ctl.failed_stage == "canary"
+                assert "MISMATCH" in str(ctl.error)
+                assert ctl.quarantined
+                assert os.path.exists(ckpt + QUARANTINE_SUFFIX)
+                # BLUE uninterrupted: same replicas, every storm
+                # response complete and oracle-exact on the old params.
+                assert fl.handles == blues
+                assert not fl.rejected
+                assert set(fl.results) >= {r.rid for r in reqs}
+                _check_versioned_oracle(fl, reqs)
+                _check_kv_clean(fl)
+                # Probe bookkeeping fully scrubbed.
+                assert not any(r.startswith("~rollover") for r in
+                               list(fl.results) + list(fl._pending)
+                               + list(fl.partial) + list(fl._requests))
+                snap = _csnap()
+                assert snap.get("tdx.fleet.rollover_canary_mismatch", 0) - \
+                    base.get("tdx.fleet.rollover_canary_mismatch", 0) >= 1
+    finally:
+        observe.enable(None)
+        observe.health.reset()
+
+
+def test_rollover_config_validation():
+    with pytest.raises(ValueError, match="probe_prompts"):
+        RolloverConfig(probe_prompts=())
+    with pytest.raises(ValueError, match="probe_new_tokens"):
+        RolloverConfig(probe_new_tokens=0)
+    assert "stale_version" in REJECT_REASONS
+
+
+def test_checkpoint_version_stamp(tmp_path):
+    """The serving weight-version stamp: directory name + 8-hex commit
+    digest for a committed checkpoint, ``@uncommitted`` otherwise."""
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    path = str(tmp_path / "step_7")
+    save_checkpoint(path, params)
+    v = checkpoint_version(path)
+    assert v.startswith("step_7@") and len(v.split("@")[1]) == 8
+    assert v == checkpoint_version(path)  # stable
+    bare = tmp_path / "step_8"
+    bare.mkdir()
+    assert checkpoint_version(bare) == "step_8@uncommitted"
+
+
+# -- shutdown racing a roll ---------------------------------------------------
+
+
+def test_shutdown_races_green_bring_up(shared_cache, tmp_path):
+    """``ServeFleet.shutdown()`` during GREEN bring-up must join the
+    spin-up thread, release its KV pool, and leave no page refcounts
+    behind — the stop path runs even when the replica never served."""
+    with tdx_config.override(cache_dir=shared_cache):
+        fl = _fleet(min_replicas=1, max_replicas=3)
+        try:
+            fl.start(1, timeout=240.0)
+            ckpt = _save_next(fl, tmp_path)
+            ctl = fl.start_rollover(ckpt)
+            deadline = time.monotonic() + 240.0
+            while ctl.green is None:
+                assert time.monotonic() < deadline, ctl.stage
+                fl.tick()
+                time.sleep(0.001)
+            green = ctl.green
+            handles = list(fl.handles)
+        finally:
+            fl.shutdown()
+        for h in handles:
+            # shutdown() already joined with its own bound; a cold-cache
+            # GREEN may still be inside spin_up, so give the stop path
+            # time to run before pinning the post-conditions.
+            assert h.thread is not None
+            h.thread.join(timeout=240.0)
+            assert not h.thread.is_alive(), (
+                f"r{h.idx} thread leaked through shutdown")
+            if h.engine is not None:
+                assert h.engine.kv.pages_in_use == 0, (
+                    h.idx, h.engine.kv.pages_in_use)
+                assert h.engine.k_pages is None  # pool actually freed
+        assert green in handles  # the race really covered GREEN

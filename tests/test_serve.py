@@ -4,6 +4,7 @@ staggered admission, page-pool preemption, and injected replica faults;
 replica bring-up through a warmed registry performs zero local compiles;
 the serve telemetry vocabulary is emitted."""
 
+import os
 import shutil
 import tempfile
 
@@ -277,6 +278,14 @@ def test_registry_warmed_bring_up_zero_local_compiles():
     warm_cache = tempfile.mkdtemp(prefix="tdx_serve_ca_")
     fresh_cache = tempfile.mkdtemp(prefix="tdx_serve_cb_")
     observe.enable(True)
+    # Persist even trivial programs: the `cow` page-copy compiles in
+    # ~0.1 s on a warm process, straddling jax's default
+    # min_compile_time_secs — whether warm_serving's cache file (and so
+    # the registry entry) exists would otherwise depend on process
+    # warmth, not the contract under test.
+    old_min = os.environ.get("TDX_CACHE_MIN_COMPILE_S")
+    os.environ["TDX_CACHE_MIN_COMPILE_S"] = "0"
+    mat._reset_cache_binding()
     try:
         summary = warm_serving("llama", LLAMA, warm_cache,
                                registry_dir=reg, serve_cfg=SCFG)
@@ -309,6 +318,10 @@ def test_registry_warmed_bring_up_zero_local_compiles():
         _check_oracle(eng, [r], out)
     finally:
         observe.enable(None)
+        if old_min is None:
+            os.environ.pop("TDX_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["TDX_CACHE_MIN_COMPILE_S"] = old_min
         mat._reset_cache_binding()
         for d in (reg, warm_cache, fresh_cache):
             shutil.rmtree(d, ignore_errors=True)

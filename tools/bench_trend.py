@@ -97,6 +97,15 @@ GATES: List[Tuple[str, str, float]] = [
     # ratio hugs 1.0 by construction, so it gets a tight floor — and
     # must stay ABOVE the generic _speedup entry (first match wins).
     (r"^ledger_overhead_ratio$", "up", 0.10),
+    # Blue-green rollover cost (bench.py serving_rollover phase, r20
+    # on): mid-roll tokens/s over steady-state, same storm, same host.
+    # The phase gates >= 0.9 absolutely (a roll is a background
+    # activity, not a brownout); the trend gate catches the ratio
+    # quietly decaying.  It is a sub-second same-host storm ratio with
+    # a GREEN bring-up racing it (same noise class as the guardrails
+    # tail), so it gets the loose floor — and must stay ABOVE the
+    # generic _speedup entry (first match wins).
+    (r"^rollover_tokens_per_s_ratio$", "up", 0.50),
     (r"_speedup$", "up", 0.15),
     (r"_mfu$", "up", 0.15),
     (r"_rss_mb$", "down", 0.15),

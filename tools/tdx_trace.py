@@ -580,6 +580,10 @@ def autopsy_report(events: List[dict], flight_docs: List[dict],
             f"attempts={detail.get('attempts', 1)}"]
     if detail.get("hedged"):
         head.append("hedged")
+    if detail.get("version") is not None:
+        # Which weight version served it — old-vs-new attribution for
+        # tail regressions during a blue-green roll (/tail blame).
+        head.append(f"version={detail['version']}")
     head.append(f"tokens={detail.get('tokens', 0)}")
     if detail.get("n_prompt") is not None:
         head.append(f"prompt={detail['n_prompt']}")
@@ -864,6 +868,16 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
                 if k:
                     per = reg_spans.setdefault(str(k), {})
                     per[e["name"]] = per.get(e["name"], 0) + 1
+        # Per-replica weight versions (latest dump's /readyz body wins):
+        # a half-rolled fleet shows up as two versions side by side.
+        versions: Dict[str, str] = {}
+        for doc in dumps:
+            replicas = ((doc.get("health") or {}).get("fleet") or {}).get(
+                "replicas") or {}
+            got = {r: str(info["version"]) for r, info in replicas.items()
+                   if isinstance(info, dict) and info.get("version")}
+            if got:
+                versions = got
         row = {
             "host": host,
             "spans": len(spans),
@@ -876,6 +890,7 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
             "reasons": reasons,
             "slowest": slowest,
             "reg_spans": reg_spans,
+            "versions": versions,
         }
         rows.append(row)
         for k in ("hit", "miss", "fetch", "steal", "chaos"):
@@ -912,6 +927,18 @@ def fleet_report(paths: List[str], top: int = 3) -> Tuple[str, int]:
         for r in dump_rows:
             body = ", ".join(f"{k}×{v}" for k, v in sorted(r["reasons"].items()))
             lines.append(f"  {r['host']:<16} {body}")
+    ver_rows = [r for r in rows if r["versions"]]
+    if ver_rows:
+        lines.append("")
+        lines.append("serving weight versions (per replica, from /readyz):")
+        for r in ver_rows:
+            by_ver: Dict[str, List[str]] = {}
+            for rep, ver in sorted(r["versions"].items()):
+                by_ver.setdefault(ver, []).append(rep)
+            body = "  ".join(f"{v} [{', '.join(reps)}]"
+                             for v, reps in sorted(by_ver.items()))
+            mixed = "  ** MID-ROLL **" if len(by_ver) > 1 else ""
+            lines.append(f"  {r['host']:<16} {body}{mixed}")
     if slo_sections:
         lines.append("")
         lines.append("serve SLOs per host (sliding window):")

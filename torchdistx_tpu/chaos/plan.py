@@ -17,6 +17,7 @@ Text grammar (``TDX_FAULT_PLAN`` / :func:`parse_plan`)::
            | 'serve'                                (serving engine)
            | 'fleet'                                (fleet replica)
            | 'reshard'                              (checkpoint reshard)
+           | 'rollover'                             (blue-green weight roll)
     kind  := 'raise' | 'hang' | 'corrupt' | 'slow' | 'preempt' | 'flap'
 
 Examples::
@@ -45,6 +46,13 @@ Examples::
     reshard@2=corrupt:flip       # bit-flip the 2nd in-flight transfer
                                  # chunk of a checkpoint reshard (caught
                                  # by the bitwise verify stage)
+    rollover@1=corrupt:flip      # bit-flip the NEW checkpoint as the
+                                 # roll fetches it (stage 1 = fetch) —
+                                 # caught by verify + quarantined, the
+                                 # BLUE fleet keeps serving
+    rollover@2=preempt           # kill the GREEN canary replica before
+                                 # its probes are judged (stage 2 =
+                                 # canary): the roll aborts, BLUE serves
     fleet@2=flap:0.3             # replica 2 FLAPS: an intermittent,
                                  # recurring fault that fires on 30% of
                                  # its matches (deterministic pattern,
@@ -93,6 +101,14 @@ of a checkpoint redistribution (1-based chunk number; kinds ``raise`` /
 chunk buffer — never any file — so the reshard verify stage catches it,
 the destination stays uncommitted, and the SOURCE checkpoint is left
 untouched (degrade-never-corrupt; docs/robustness.md §Resharding).
+The ``rollover`` site is keyed by ROLL STAGE rather than step — 1=fetch,
+2=canary, 3=shift, 4=drain (kinds ``raise`` / ``hang`` / ``corrupt`` /
+``preempt``): ``corrupt`` damages the INCOMING checkpoint's payload
+(meaningful at the fetch stage, where verification catches and
+quarantines it); ``preempt`` kills only the GREEN canary replica's
+thread, never the process; any of them aborts the roll while the BLUE
+fleet keeps serving the old weights uninterrupted (docs/serving.md
+§Weight rollover).
 """
 
 from __future__ import annotations
@@ -103,7 +119,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 SITES = ("step", "save", "restore", "lower", "compile", "execute", "cache",
-         "registry", "serve", "fleet", "reshard")
+         "registry", "serve", "fleet", "reshard", "rollover")
 KINDS = ("raise", "hang", "corrupt", "slow", "preempt", "flap")
 _FLAP_DEFAULT_DUTY = 0.5
 

@@ -246,6 +246,16 @@ def dump(reason: str, **context) -> Optional[str]:
         }
         if ledger is not None:
             doc["ledger"] = ledger
+        try:
+            # Extra (non-schema) key: the /readyz body — per-replica
+            # bring-up states carrying the WEIGHT VERSION each replica
+            # serves (set_info), so a dump taken mid-roll shows the
+            # half-rolled fleet (tools/tdx_trace.py fleet).
+            from . import health
+
+            doc["health"] = health.readiness()[1]
+        except Exception:
+            pass
         os.makedirs(fdir, exist_ok=True)
         path = os.path.join(
             fdir, f"flight-{os.getpid()}-{seq:03d}-{_safe(reason)}.json"

@@ -37,6 +37,7 @@ __all__ = [
     "liveness",
     "readiness",
     "reset",
+    "set_info",
     "set_state",
     "snapshot",
 ]
@@ -54,6 +55,7 @@ _MIN_ALLOWANCE_S = 15.0
 _lock = threading.Lock()
 _states: Dict[str, Tuple[str, float]] = {}          # name -> (state, since)
 _beats: Dict[str, Tuple[float, Optional[float]]] = {}  # name -> (t, hint)
+_infos: Dict[str, Dict[str, object]] = {}           # name -> metadata
 
 
 def set_state(component: str, state: str) -> None:
@@ -68,11 +70,28 @@ def set_state(component: str, state: str) -> None:
         instant(f"{component}.state", category="health", state=state)
 
 
+def set_info(component: str, **info: object) -> None:
+    """Attach static metadata to a component's probe rows — e.g. the
+    weight version a fleet replica serves (``set_info("fleet/r2",
+    version="step_8@a1b2c3d4")``), so ``/readyz`` shows a half-rolled
+    fleet at a glance.  Merged into the component's ``snapshot()`` /
+    ``readiness()`` row; ``None`` values are dropped; cleared with the
+    state."""
+    with _lock:
+        cur = _infos.setdefault(component, {})
+        for k, v in info.items():
+            if v is None:
+                cur.pop(k, None)
+            else:
+                cur[k] = v
+
+
 def clear_state(component: str) -> None:
     """Forget a component (a fleet replica that scaled away): a removed
     replica must stop counting toward — or against — readiness."""
     with _lock:
         _states.pop(component, None)
+        _infos.pop(component, None)
 
 
 def beat(name: str, period_hint_s: Optional[float] = None) -> None:
@@ -87,7 +106,8 @@ def snapshot() -> dict:
     now = time.monotonic()
     with _lock:
         states = {
-            name: {"state": st, "for_s": round(now - since, 3)}
+            name: {"state": st, "for_s": round(now - since, 3),
+                   **_infos.get(name, {})}
             for name, (st, since) in _states.items()
         }
         beats = {
@@ -161,3 +181,4 @@ def reset() -> None:
     with _lock:
         _states.clear()
         _beats.clear()
+        _infos.clear()

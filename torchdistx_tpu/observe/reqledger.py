@@ -75,6 +75,7 @@ __all__ = [
     "on_finish",
     "on_reject",
     "on_spec",
+    "on_version",
     "requests_report",
     "reset",
     "summary",
@@ -114,6 +115,7 @@ class _Record:
         "n_prompt", "prefix_tokens", "hedged", "cow_copies", "tokens",
         "outcome", "e2e_s", "_decode_ev",
         "spec_drafted", "spec_accepted", "spec_ticks", "_spec_ev",
+        "version",
     )
 
     def __init__(self, rid: str, now: float, flow: Optional[int],
@@ -149,6 +151,10 @@ class _Record:
         self.spec_accepted = 0
         self.spec_ticks = 0
         self._spec_ev: Optional[dict] = None
+        # Weight version the serving replica ran (blue-green rollover):
+        # lets /tail blame attribute a mid-roll tail regression to old
+        # vs new weights.  None outside a fleet / before any roll.
+        self.version: Optional[str] = None
 
     # -- stage machine ---------------------------------------------------
 
@@ -217,6 +223,8 @@ class _Record:
             out["spec_ticks"] = self.spec_ticks
         if self.priority is not None:
             out["priority"] = self.priority
+        if self.version is not None:
+            out["version"] = self.version
         if self.n_prompt is not None:
             out["n_prompt"] = self.n_prompt
         if self.e2e_s is not None:
@@ -415,6 +423,22 @@ def on_cow(rid: str, *, replica: str = "local") -> None:
         rec.add_event(now, "cow", replica=replica)
 
 
+def on_version(rid: str, version: Optional[str]) -> None:
+    """Stamp the weight version the request is being served under
+    (blue-green rollover): called at dispatch time so the terminal
+    ``serve.request`` instant — which finalizes on the replica thread,
+    before the controller reaps — already carries it.  Re-dispatch after
+    a requeue restamps (last wins; an unpinned requeue may legitimately
+    land on the new weights)."""
+    if version is None or not enabled():
+        return
+    with _LOCK:
+        rec = _get(rid)
+        if rec is None:
+            return
+        rec.version = version
+
+
 def on_abort(rid: str, *, replica: str = "local", reason: str = "") -> None:
     """An attempt ended without finishing (preempt, replica death,
     hedge loss, mid-decode deadline cancel).  The attempt's
@@ -596,6 +620,23 @@ def tail_report() -> Dict[str, Any]:
         blame[st] = round(sum(shares) / len(shares), 4) if shares else 0.0
     out["p99_blame"] = blame
     out["p99_sample"] = k
+    # Per-weight-version latency split (blue-green rollover): when any
+    # completed request in the window carries a version stamp, break the
+    # tail down old-vs-new so a mid-roll regression is attributable.
+    by_ver: Dict[str, List[float]] = {}
+    for s in done:
+        v = s.get("version")
+        if v is not None:
+            by_ver.setdefault(v, []).append(s["e2e_s"])
+    if by_ver:
+        out["by_version"] = {
+            v: {
+                "completed": len(vals),
+                "p50": round(_pctl(sorted(vals), 0.5), 6),
+                "p95": round(_pctl(sorted(vals), 0.95), 6),
+            }
+            for v, vals in by_ver.items()
+        }
     return out
 
 

@@ -58,6 +58,7 @@ from .guardrails import (
 )
 from .kv_cache import KVCacheConfig, OutOfPages, PagedKVCache, init_pools
 from .prefix import NgramDrafter, PrefixCache
+from .rollover import RollError, RolloverConfig, RolloverController
 from .router import (
     AdmissionQueue,
     FleetRejected,
@@ -95,6 +96,9 @@ __all__ = [
     "Rejection",
     "ReplicaHandle",
     "Request",
+    "RollError",
+    "RolloverConfig",
+    "RolloverController",
     "ServeConfig",
     "ServeEngine",
     "ServeFleet",

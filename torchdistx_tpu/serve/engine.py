@@ -167,6 +167,11 @@ class ServeEngine:
         self.family = family
         self.cfg = cfg
         self.params = params
+        # Weight-version stamp (checkpoint step + manifest digest) of
+        # the params this engine serves; None until a rollover installs
+        # versioned weights.  Surfaced on /readyz and the request
+        # ledger so a half-rolled fleet is visible at a glance.
+        self.weight_version: Optional[str] = None
         self.scfg: ResolvedServeConfig = (serve_cfg or ServeConfig()).resolve(cfg)
         self.mesh, self.plan = mesh, plan
         self._seed, self._param_dtype = seed, param_dtype
@@ -455,6 +460,25 @@ class ServeEngine:
             reqledger.on_reject(rid, reason="deadline", tokens=len(toks))
             if self.on_cancel is not None:
                 self.on_cancel(rid, toks, was_active)
+
+    def install_params(self, params, *, version: Optional[str] = None) -> None:
+        """Swap the weights this engine serves (blue-green rollover:
+        the GREEN replica is spun up registry-warm on the fleet's
+        current params, then the restored step-N+1 tree is installed
+        before it serves).  Programs read ``self.params`` at call time,
+        so the swap needs no recompile; it is only legal while no lane
+        is active, and it clears the prefix cache — KV computed under
+        the old weights must never be decoded under the new ones
+        (stale-KV corruption is exactly the torn output the rollover
+        canary exists to prevent)."""
+        if self.active:
+            raise RuntimeError(
+                f"install_params with {len(self.active)} active lanes; "
+                f"drain first"
+            )
+        self.prefix.clear()
+        self.params = params
+        self.weight_version = version
 
     def release_kv(self) -> None:
         """Free the replica's KV pool (the end of a drain): drop the

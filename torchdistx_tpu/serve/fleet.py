@@ -81,6 +81,19 @@ and rejects new low-priority work at the door, exiting on hysteresis.
 All four preserve the oracle gate: every request that completes is
 bitwise-equal to ``oracle_generate``; every request that does not
 carries exactly one typed rejection.
+
+**Weight rollover** (docs/serving.md §Weight rollover;
+:mod:`.rollover`): :meth:`ServeFleet.start_rollover` rolls the live
+fleet onto a new checkpoint blue-green — a GREEN replica spins up
+registry-warm on the new weights, must reproduce the new offline
+oracle bitwise on a probe set (the canary gate) before taking traffic,
+then the BLUE replicas drain one at a time.  The controller keeps a
+per-request weight-version pin so an in-flight request finishes on the
+weights it started on — never migrated across versions mid-decode —
+and every completion is bitwise-equal to the oracle FOR ITS VERSION
+(:attr:`ServeFleet.served_version` + ``version_params`` record which).
+A canary mismatch or GREEN fault aborts the roll, quarantines the bad
+checkpoint, and leaves BLUE untouched.
 """
 
 from __future__ import annotations
@@ -210,6 +223,10 @@ class ReplicaHandle:
         self.breaker: Optional[CircuitBreaker] = None  # controller-owned
         self.half_open = False                # quarantine probe: one request
         self.tripped = False                  # breaker ejected it
+        # Blue-green rollover (docs/serving.md §Weight rollover):
+        self.weight_version: Optional[str] = None  # ckpt stamp it serves
+        self.params_override = None   # installed post-spin-up (GREEN)
+        self.canary = False           # out of rotation: probe work only
         self._slow_counted: Optional[float] = None  # last beat flagged slow
         self.stop_evt = threading.Event()
         self.drain_evt = threading.Event()
@@ -306,6 +323,20 @@ class ServeFleet:
         self._resolved = (serve_cfg or ServeConfig()).resolve(cfg)
         self._kvcfg = self._resolved.kv_config(cfg)
         self.params = None            # first replica's params (oracle use)
+        # Blue-green rollover state (docs/serving.md §Weight rollover).
+        # ``active_version`` is the stamp new work routes to (None until
+        # a roll shifts traffic — None == None keeps the pre-roll fleet
+        # on the legacy single-version dispatch path); ``_rid_version``
+        # pins in-flight requests to the version they dispatched under;
+        # ``served_version`` / ``version_params`` record, per finished
+        # rid, which weights produced it — the per-version oracle key.
+        self.active_version: Optional[str] = None
+        self.version_params: Dict[Optional[str], object] = {}
+        self.served_version: Dict[str, Optional[str]] = {}
+        self._rid_version: Dict[str, Optional[str]] = {}
+        self._spawn_params = None     # weights NEW replicas install
+        self._spawn_version: Optional[str] = None
+        self.rollover = None          # in-flight RolloverController
         self.queue = AdmissionQueue(max_depth=self.fc.max_queue)
         self.autoscaler = Autoscaler(self.fc)
         self.handles: List[ReplicaHandle] = []       # launch order
@@ -342,16 +373,31 @@ class ServeFleet:
             self.wait_replicas(n, timeout=timeout)
         return self
 
-    def scale_up(self, *, wait: bool = False,
-                 timeout: float = 300.0) -> ReplicaHandle:
+    def scale_up(self, *, wait: bool = False, timeout: float = 300.0,
+                 params=None, version: Optional[str] = None,
+                 canary: bool = False) -> ReplicaHandle:
         """Launch one replica.  The effective ``tdx_config`` (cache dir,
         registry dir, ...) is captured HERE, on the calling thread, and
         re-entered on the replica thread via ``tdx_config.bind`` —
         thread-local ``override`` scopes are invisible to spawned
         threads, and the registry-warm bring-up contract depends on the
-        replica seeing the caller's registry_dir."""
+        replica seeing the caller's registry_dir.
+
+        ``params``/``version`` install explicit weights after the
+        registry-warm spin-up (the rollover's GREEN bring-up); with
+        neither given the fleet's spawn defaults apply, so floor
+        backfills, autoscale-ups, and half-open probes after a shifted
+        roll all come up on the NEW weights.  ``canary=True`` keeps the
+        replica out of dispatch rotation (probe traffic only)."""
         h = ReplicaHandle(self._next_idx, tdx_config.get())
         self._next_idx += 1
+        if params is None:
+            params = self._spawn_params
+            if version is None:
+                version = self._spawn_version
+        h.params_override = params
+        h.weight_version = version
+        h.canary = canary
         if self.gc is not None and self.gc.breaker:
             h.breaker = CircuitBreaker(self.gc)
         self.handles.append(h)
@@ -384,8 +430,11 @@ class ServeFleet:
         """Start draining the least-loaded serving replica: it finishes
         its in-flight lanes, gets no new work, hands back its unadmitted
         backlog, and frees its KV pool; the controller requeues the
-        backlog and removes it (:meth:`tick`)."""
-        serving = [h for h in self.handles if h.state == "serving"]
+        backlog and removes it (:meth:`tick`).  A canary is never the
+        victim — draining the GREEN probe mid-canary would wreck an
+        otherwise healthy roll."""
+        serving = [h for h in self.handles
+                   if h.state == "serving" and not h.canary]
         if not serving:
             return None
         h = least_outstanding(serving, lambda x: x.outstanding())
@@ -408,6 +457,24 @@ class ServeFleet:
             self.tick()
             self._wake.wait(0.005)
             self._wake.clear()
+
+    def start_rollover(self, checkpoint_path, *, cfg=None):
+        """Begin a blue-green roll of the live fleet onto the committed
+        checkpoint at ``checkpoint_path``.  The roll is driven
+        stage-by-stage from :meth:`tick` (fetch → canary → shift →
+        drain), so it proceeds concurrently with a live storm; the
+        returned :class:`~.rollover.RolloverController` exposes the
+        stage, outcome, and digest (docs/serving.md §Weight
+        rollover)."""
+        from .rollover import RolloverController
+
+        if self.rollover is not None:
+            raise RuntimeError(
+                f"a rollover is already in flight "
+                f"(stage={self.rollover.stage})")
+        ctl = RolloverController(self, checkpoint_path, cfg=cfg)
+        ctl.start()
+        return ctl
 
     # -- admission ----------------------------------------------------------
 
@@ -433,6 +500,7 @@ class ServeFleet:
     def _reject(self, rejection: Rejection) -> None:
         self.rejected[rejection.rid] = rejection
         self._pending.discard(rejection.rid)
+        self._rid_version.pop(rejection.rid, None)
         observe.counter("tdx.fleet.rejected_requests",
                         reason=rejection.reason).inc()
         observe.instant("fleet.reject", category="serve",
@@ -529,6 +597,11 @@ class ServeFleet:
             self._service_quarantine(now)
             self._settle_hedges()
             self._brownout_tick()
+        if self.rollover is not None:
+            # Roll stages run on the controller tick, after reaps (so
+            # canary completions are visible) and before dispatch (so a
+            # shift redirects this tick's traffic).
+            self.rollover.step()
         self._dispatch()
         self._autoscale(now)
         if observe.enabled():
@@ -547,6 +620,10 @@ class ServeFleet:
                 self._pending.discard(rid)   # replica may double-finish
                 self.results[rid] = toks
                 self.final_logits[rid] = logits
+                # Which weights produced this output — the per-version
+                # oracle key (fleet.version_params[served_version[rid]]).
+                self.served_version[rid] = h.weight_version
+                self._rid_version.pop(rid, None)
                 with self._stream_lock:
                     self.partial.pop(rid, None)
                     self._first_replica.pop(rid, None)
@@ -584,6 +661,13 @@ class ServeFleet:
                 continue
             self.queue.requeue(req)
             h.assigned.discard(req.rid)
+            with self._stream_lock:
+                streamed = self._stream_pos.get(req.rid, 0)
+            if streamed == 0:
+                # Nothing delivered yet: unpin, so the re-dispatch may
+                # legally land on any version (drained leftovers and
+                # killed-before-first-token requests regenerate whole).
+                self._rid_version.pop(req.rid, None)
             observe.counter("tdx.fleet.requeued_requests").inc()
             observe.instant("fleet.requeue", category="serve",
                             rid=req.rid, replica=h.idx, reason=why,
@@ -797,23 +881,30 @@ class ServeFleet:
     # -- dispatch -----------------------------------------------------------
 
     def _dispatch(self) -> None:
-        serving = [h for h in self.handles if h.state == "serving"]
+        serving = [h for h in self.handles
+                   if h.state == "serving" and not h.canary]
         if not serving:
             return
         cap = max(1, int(self._resolved.max_batch
                          * self.fc.dispatch_per_replica))
         now = time.monotonic()
+        deferred: List[Request] = []
         while True:
             # A half-open replica is on probation: exactly ONE request
             # until its probe completes (docs/serving.md §Guardrails).
             ready = [h for h in serving
                      if len(h.assigned) < (1 if h.half_open else cap)]
             if not ready:
-                return  # backlog stays queued → visible scale pressure
+                break  # backlog stays queued → visible scale pressure
             entry = self.queue.pop(now=now)
             if entry is None:
-                return
+                break
             req = entry.req
+            if req.rid not in self._pending:
+                # Resolved while queued (an aborted roll dropped its
+                # probe rids; a breaker/brownout path rejected it):
+                # dispatching would burn a lane on a dead rid.
+                continue
             dl = getattr(req, "_deadline_t", None)
             if dl is not None and time.perf_counter() > dl:
                 # Dispatch-time deadline check: requeued entries are
@@ -823,18 +914,42 @@ class ServeFleet:
                 # rejection carrying whatever was already delivered.
                 self._reject_deadline(req.rid, where="dispatch")
                 continue
+            # Version-aware routing (docs/serving.md §Weight rollover):
+            # a request that already streamed tokens under one weight
+            # version is PINNED to it — migrating mid-decode would tear
+            # the output across versions — while unpinned work routes
+            # to the fleet's active version.  Pre-roll fleets have
+            # every version None, so the filter is the identity.
+            pinned = req.rid in self._rid_version
+            want = (self._rid_version[req.rid] if pinned
+                    else self.active_version)
+            cand = [h for h in ready if h.weight_version == want]
+            if not cand:
+                if pinned and not any(h.weight_version == want
+                                      for h in self.handles):
+                    # The version it streamed under is fully retired —
+                    # no live or draining replica can ever resume it.
+                    self._reject_stale(req.rid)
+                    continue
+                deferred.append(req)  # capacity may appear next tick
+                continue
             h, affine = prefix_affinity(
-                ready, lambda x: x.outstanding(),
+                cand, lambda x: x.outstanding(),
                 lambda x: x.prefix_match_tokens(req.tokens),
             )
             if affine:
                 observe.counter("tdx.fleet.prefix_affinity_hits").inc()
+            self._rid_version[req.rid] = h.weight_version
+            reqledger.on_version(req.rid, h.weight_version)
             h.give(req)
-            if self.gc is not None and len(ready) > 1:
+            if self.gc is not None and len(cand) > 1:
                 waited = now - entry.enqueued_t
                 if (req.rid not in self._hedges
                         and should_hedge(waited, req.deadline_s, self.gc)):
-                    mates = [x for x in ready
+                    # Hedge twins must serve the SAME weight version:
+                    # first-token-wins arbitration across versions
+                    # would be a cross-version torn output.
+                    mates = [x for x in cand
                              if x is not h and not x.half_open]
                     mate = least_outstanding(mates,
                                              lambda x: x.outstanding())
@@ -850,6 +965,13 @@ class ServeFleet:
                         )
                         reqledger.on_event(req.rid, "hedge",
                                            primary=h.idx, mate=mate.idx)
+        for req in deferred:
+            # No replica of the right version had room THIS tick (e.g.
+            # mid-shift, before GREEN capacity caught up): back to the
+            # queue's exempt front lane, retried next tick.  The
+            # backlog stays visible to the autoscaler, whose spawn
+            # defaults track the shifted version.
+            self.queue.requeue(req)
 
     def _reject_deadline(self, rid: str, *, where: str) -> None:
         """Typed ``deadline`` rejection carrying tokens-so-far; also
@@ -869,6 +991,25 @@ class ServeFleet:
                 h.cancels.append((rid, "deadline"))
                 h.work_evt.set()
         self._hedges.pop(rid, None)
+
+    def _reject_stale(self, rid: str) -> None:
+        """Typed ``stale_version`` rejection: the weight version this
+        request streamed tokens under retired mid-roll (its last
+        replica died before the request finished), and continuing the
+        stream on any other version would tear the output.  Exactly one
+        rejection, carrying the delivered-so-far tokens — which remain
+        an exact prefix of the retired version's oracle."""
+        with self._stream_lock:
+            partial = tuple(self.partial.pop(rid, ()))
+            self._first_replica.pop(rid, None)
+        want = self._rid_version.get(rid)
+        observe.counter("tdx.fleet.stale_version_rejects").inc()
+        self._reject(Rejection(
+            rid, "stale_version",
+            f"weight version {want} retired mid-roll; "
+            f"{len(partial)} tokens delivered",
+            tokens=partial,
+        ))
 
     def _autoscale(self, now: float) -> None:
         serving = sum(1 for h in self.handles if h.state == "serving")
@@ -948,7 +1089,6 @@ class ServeFleet:
         request requeued onto a new replica regenerates from position 1
         — the client must not hear those positions twice."""
         counts: Dict[str, int] = {}  # this replica's delivered positions
-        user = self.on_token
 
         def _on_token(rid: str, token: int) -> None:
             pos = counts.get(rid, 0) + 1
@@ -965,6 +1105,17 @@ class ServeFleet:
                 # other copy on its next tick (_settle_hedges).
                 if pos == 1:
                     self._first_replica[rid] = h.idx
+                    # First token pins the served version for partial-
+                    # output attribution (a stale_version / deadline
+                    # rejection's tokens oracle-check against THESE
+                    # weights); a completion overwrites it with the
+                    # finishing replica's stamp — same version by the
+                    # pinning invariant.
+                    self.served_version[rid] = h.weight_version
+            # Read at call time, not closure-capture at spin-up: a
+            # driver may install the hook on a fleet whose replicas
+            # are already serving (open-loop TTFT measurement).
+            user = self.on_token
             if user is not None:
                 user(rid, token)
 
@@ -1021,11 +1172,24 @@ class ServeFleet:
                     health_component=h.component, slo_name=h.slo_name,
                 )
                 h.engine = engine
+                if h.params_override is not None:
+                    # GREEN bring-up: the registry-warm spin-up compiled
+                    # (or fetched) the programs on the fleet's current
+                    # weights; the rolled checkpoint's tree is installed
+                    # here, pre-serving — programs read params at call
+                    # time, so the swap costs zero compiles.
+                    engine.install_params(h.params_override,
+                                          version=h.weight_version)
                 h.bring_up_seconds = engine.bring_up_seconds
                 h.bring_up_warm = (
                     "miss" not in set(engine.bring_up_outcomes.values()))
                 if self.params is None:
                     self.params = engine.params
+                self.version_params.setdefault(h.weight_version,
+                                               engine.params)
+                if h.weight_version is not None and not h.reaped:
+                    observe.health.set_info(h.component,
+                                            version=h.weight_version)
                 if h.bring_up_warm and observe.enabled():
                     observe.gauge("tdx.fleet.spin_up_warm_s").set(
                         round(engine.bring_up_seconds, 3))
@@ -1084,3 +1248,13 @@ class ServeFleet:
                 h.beat()
                 h.work_evt.wait(0.002)
                 h.work_evt.clear()
+        # Stop-initiated exit (fleet shutdown, or a reaped/aborted
+        # canary): free the pool HERE, so a shutdown racing an
+        # in-flight scale-up or roll can never leak KV pages — the
+        # drain path released above, but a stop_evt used to walk out
+        # with the pool (and any active lanes' pages) still held.
+        # Active lanes are preempted first, which frees their pages
+        # and keeps the recompute contract if they ever run again.
+        if engine.active:
+            engine.requeue_active(reason="stop")
+        engine.release_kv()

@@ -62,6 +62,12 @@ class GuardrailConfig:
     slow_tick_s: Optional[float] = None  # beat gap counted as an observation
     quarantine_s: float = 2.0           # initial backoff after a trip
     quarantine_max_s: float = 60.0      # exponential-backoff cap
+    backoff_cap_s: Optional[float] = None  # explicit doubling ceiling
+    # ``backoff_cap_s`` exists so the probe-failure doubling can be
+    # capped BELOW quarantine_max_s: a replica that flapped early in a
+    # long run must re-earn rotation in bounded time, not be expelled
+    # for the full quarantine_max_s horizon.  None inherits
+    # quarantine_max_s (so the default cap is the documented ~60s).
     # -- hedged dispatch ----------------------------------------------------
     hedging: bool = True
     hedge_wait_frac: float = 0.5        # hedge when waited > frac × deadline
@@ -86,6 +92,9 @@ class GuardrailConfig:
             raise ValueError(
                 f"need 0 < quarantine_s <= quarantine_max_s, got "
                 f"{self.quarantine_s} / {self.quarantine_max_s}")
+        if self.backoff_cap_s is not None and self.backoff_cap_s <= 0:
+            raise ValueError(
+                f"backoff_cap_s must be > 0, got {self.backoff_cap_s}")
         if not (0.0 <= self.hedge_wait_frac):
             raise ValueError(
                 f"hedge_wait_frac must be >= 0, got {self.hedge_wait_frac}")
@@ -134,7 +143,9 @@ class QuarantineEntry:
     probe_idx: Optional[int] = None  # the in-flight half-open replica
 
     def fail_probe(self, now: float, gc: GuardrailConfig) -> None:
-        self.backoff_s = min(self.backoff_s * 2.0, gc.quarantine_max_s)
+        cap = gc.backoff_cap_s if gc.backoff_cap_s is not None \
+            else gc.quarantine_max_s
+        self.backoff_s = min(self.backoff_s * 2.0, cap)
         self.until = now + self.backoff_s
         self.probe_idx = None
 

@@ -49,7 +49,8 @@ __all__ = [
     "prefix_affinity",
 ]
 
-REJECT_REASONS = ("queue_full", "deadline", "invalid", "shed")
+REJECT_REASONS = ("queue_full", "deadline", "invalid", "shed",
+                  "stale_version")
 
 
 @dataclass(frozen=True)
@@ -60,7 +61,12 @@ class Rejection:
     A ``deadline`` rejection issued after admission (a lane cancelled
     mid-decode, docs/serving.md §Guardrails) carries the tokens the
     client already received in ``tokens``; ``shed`` is the brownout
-    reason (low-priority work dropped under sustained pressure)."""
+    reason (low-priority work dropped under sustained pressure);
+    ``stale_version`` is the rollover reason — a request that already
+    streamed tokens under a weight version whose last replica died
+    mid-roll can neither migrate to the new weights (torn output) nor
+    wait for a version that is never coming back, so it terminates with
+    its delivered-so-far tokens (docs/serving.md §Weight rollover)."""
 
     rid: str
     reason: str  # one of REJECT_REASONS

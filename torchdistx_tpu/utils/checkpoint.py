@@ -61,6 +61,7 @@ QUARANTINE_SUFFIX = ".corrupt"
 __all__ = [
     "AsyncCheckpointSaver",
     "CheckpointCorruptError",
+    "checkpoint_version",
     "iter_payload_files",
     "leaf_storage_name",
     "quarantine_checkpoint",
@@ -234,6 +235,25 @@ def read_manifest(path: "str | Path") -> Optional[dict]:
         return json.loads(mf.read_bytes())
     except (OSError, ValueError):
         return None
+
+
+def checkpoint_version(path: "str | Path") -> str:
+    """The serving weight-version stamp of a checkpoint:
+    ``<dirname>@<manifest-digest>`` — e.g. ``step_12@a1b2c3d4``.
+
+    The directory name carries the training step (``run_elastic`` lays
+    checkpoints out as ``step_N``); the digest is the commit marker's
+    CRC32 of the manifest bytes, which transitively covers every payload
+    byte (the manifest checksums the payload, the marker checksums the
+    manifest).  Two checkpoints with the same step but different weights
+    therefore stamp differently.  Uncommitted checkpoints stamp as
+    ``<dirname>@uncommitted`` — rollover refuses them anyway."""
+    path = Path(path)
+    try:
+        digest = (path / COMMIT_MARKER).read_text().strip()[:8]
+    except OSError:
+        digest = ""
+    return f"{path.name}@{digest or 'uncommitted'}"
 
 
 def verify_checkpoint(path: "str | Path") -> Tuple[bool, str]:
